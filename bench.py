@@ -1,0 +1,30 @@
+"""Driver benchmark entry: prints ONE JSON line with the headline metric.
+
+Current flagship: MNIST MLP training throughput on one chip (M1 slice).
+Baseline anchor: reference AlexNet 1×K40m = 334 ms/batch @bs128 → 383 img/s
+(BASELINE.md); MNIST MLP has no direct published reference number, so
+vs_baseline is reported against the reference's LSTM/MLP-class throughput
+proxy of 64/0.083s ≈ 771 samples/s (LSTM h=256 bs=64: 83 ms/batch).
+This will switch to ResNet-50 / Transformer once those land (M3/M4).
+"""
+
+import json
+import sys
+
+
+def main():
+    sys.argv = [sys.argv[0], "--batch_size", "128", "--iterations", "60",
+                "--skip_batch_num", "10"]
+    from benchmarks.mnist import main as mnist_main
+    ips = mnist_main()
+    baseline_proxy = 771.0
+    print(json.dumps({
+        "metric": "mnist_mlp_train_imgs_per_sec",
+        "value": round(float(ips), 1),
+        "unit": "imgs/sec",
+        "vs_baseline": round(float(ips) / baseline_proxy, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

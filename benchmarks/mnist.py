@@ -1,0 +1,66 @@
+"""MNIST MLP benchmark — parity with reference benchmark/fluid/mnist.py
+(timing protocol: skip first N batches, report avg samples/sec,
+mnist.py:38-50)."""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid
+
+
+def parse_args():
+    p = argparse.ArgumentParser("mnist benchmark")
+    p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--iterations", type=int, default=100)
+    p.add_argument("--skip_batch_num", type=int, default=5)
+    p.add_argument("--pass_num", type=int, default=1)
+    p.add_argument("--device", type=str, default="TPU",
+                   choices=["CPU", "TPU", "GPU"])
+    return p.parse_args()
+
+
+def build():
+    img = fluid.layers.data("img", [784])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    hidden = fluid.layers.fc(img, 128, act="relu")
+    hidden = fluid.layers.fc(hidden, 64, act="relu")
+    prediction = fluid.layers.fc(hidden, 10, act="softmax")
+    cost = fluid.layers.cross_entropy(prediction, label)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Adam(learning_rate=0.001).minimize(avg_cost)
+    return img, label, avg_cost
+
+
+def main():
+    args = parse_args()
+    img, label, avg_cost = build()
+    place = fluid.CPUPlace() if args.device == "CPU" else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(args.batch_size, 784).astype(np.float32)
+    ys = rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64)
+
+    times = []
+    for i in range(args.iterations + args.skip_batch_num):
+        t0 = time.time()
+        loss, = exe.run(feed={"img": xs, "label": ys},
+                        fetch_list=[avg_cost])
+        _ = float(np.asarray(loss))   # sync
+        if i >= args.skip_batch_num:
+            times.append(time.time() - t0)
+    ips = args.batch_size / np.mean(times)
+    print("avg %.4f ms/batch, %.1f imgs/sec" %
+          (1000 * np.mean(times), ips))
+    return ips
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,263 @@
+"""Executor: runs a Program by compiling it to one XLA computation.
+
+Reference parity: python/paddle/fluid/executor.py:181 + framework/executor.cc:133.
+The reference interprets a Program op-by-op, re-running shape inference and
+kernel dispatch per op per step (executor.cc:333 — its hot loop). Here the
+whole block is *traced once* through the op lowering registry into a pure
+function ``step(state, feeds, key) -> (fetches, new_state)`` and jit-compiled;
+subsequent runs with the same (program version, feed signature, fetch list)
+hit the compiled-step cache (the analog of executor.py:165's program cache,
+but caching an XLA executable instead of a cloned ProgramDesc).
+
+State threading: persistable variables (parameters, optimizer accumulators)
+live in a Scope between steps and are passed through the jitted function as a
+donated pytree, so in-place optimizer updates reuse device buffers instead of
+reallocating (the role the reference's buddy allocator + in-place var reuse
+played).
+
+Autodiff: a ``backward_marker`` op recorded by append_backward (core/backward.py)
+switches the tracer into ``jax.value_and_grad`` over the forward segment —
+replacing the reference's per-op GradOpDescMaker machinery (backward.py:425)
+with JAX's program transform.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .program import Program, Variable, default_main_program
+from .scope import Scope, global_scope
+from .places import CPUPlace, Place, _default_place
+from .lod import LoDTensor
+
+
+class _FetchEscape(Exception):
+    pass
+
+
+def as_numpy(value):
+    """Convert a fetched value (jax.Array / LoDTensor / list) to numpy."""
+    if isinstance(value, LoDTensor):
+        return value  # keep lod info; caller can np.asarray it
+    if isinstance(value, (list, tuple)):
+        return [as_numpy(v) for v in value]
+    return np.asarray(value)
+
+
+def _feed_signature(feed):
+    return tuple(sorted(
+        (k, tuple(np.shape(v)), str(np.asarray(v).dtype) if not hasattr(v, "dtype") else str(v.dtype))
+        for k, v in feed.items()))
+
+
+class Executor:
+    """Single-device executor (CPU or one TPU chip).
+
+    Multi-device execution is ParallelExecutor (paddle_tpu/parallel/),
+    which shards the same traced step over a jax Mesh.
+    """
+
+    def __init__(self, place=None):
+        if place is None:
+            place = _default_place()
+        if not isinstance(place, Place):
+            raise TypeError("place must be a Place, got %r" % (place,))
+        self.place = place
+        self._cache = {}          # cache key -> (jitted fn, state_keys, static info)
+        self._rng_counter = 0
+
+    # ------------------------------------------------------------------
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else str(f) for f in fetch_list)
+
+        # Normalize feeds to arrays; remember LoD for LoDTensor feeds.
+        feed_arrays, feed_lods = {}, {}
+        for k, v in feed.items():
+            if isinstance(v, LoDTensor):
+                feed_arrays[k] = v.data
+                if v.lod:
+                    # sequence ops consume per-sequence LENGTHS (not offsets)
+                    lengths = v.recursive_sequence_lengths()[-1]
+                    feed_lods[k + "@LOD"] = np.asarray(lengths, np.int32)
+            else:
+                feed_arrays[k] = np.asarray(v) if not isinstance(v, jax.Array) else v
+        feed_arrays.update(feed_lods)
+
+        # State = persistable vars of this program that exist in scope.
+        persistable = [v.name for v in program.global_block().vars.values()
+                       if v.persistable]
+        state = {n: scope.find_var(n) for n in persistable
+                 if scope.find_var(n) is not None}
+        state_keys = tuple(sorted(state))
+
+        # NB: the Program object itself is part of the key (kept alive by the
+        # cache) so id-reuse after GC can never alias two programs.
+        key = (program, program._version, _feed_signature(feed_arrays),
+               fetch_names, state_keys)
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            fn = self._build(program, tuple(sorted(feed_arrays)), fetch_names,
+                             state_keys)
+            entry = jax.jit(fn, donate_argnums=(0,))
+            if use_program_cache:
+                self._cache[key] = entry
+
+        rng_key = jax.random.key(
+            np.uint32(program.random_seed * 1000003 + self._rng_counter))
+        self._rng_counter += 1
+
+        with jax.default_device(self.place.jax_device()):
+            fetches, new_state = entry(state, feed_arrays, rng_key)
+
+        # Commit updated persistable state back to the scope.
+        for n, v in new_state.items():
+            scope.set(n, v)
+        # New persistable vars materialized by this run (e.g. startup program
+        # initializers) are committed too — _build returns them in new_state.
+
+        if os.environ.get("PADDLE_TPU_CHECK_NAN_INF"):
+            self._check_nan_inf(fetch_names, fetches)
+
+        if return_numpy:
+            return [as_numpy(v) for v in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _build(self, program, feed_names, fetch_names, state_keys):
+        """Build the pure step function for one (program, signature)."""
+        block = program.global_block()
+        ops = list(block.ops)
+        persistable_names = {v.name for v in block.vars.values()
+                             if v.persistable}
+
+        bwd_idx = None
+        for i, op in enumerate(ops):
+            if op.type in ("backward_marker", "calc_gradient_marker"):
+                bwd_idx = i
+                break
+
+        def step(state, feeds, rng_key):
+            n_splits = [0]
+
+            def rng_fn():
+                n_splits[0] += 1
+                return jax.random.fold_in(rng_key, n_splits[0])
+
+            env = {}
+            env.update(state)
+            env.update(feeds)
+            ctx = registry.LowerContext(env, rng_fn, executor=self,
+                                        block=block)
+            if bwd_idx is None:
+                for op in ops:
+                    _lower_op(ctx, op)
+            else:
+                self._lower_with_grad(ctx, ops, bwd_idx, program, block)
+
+            fetches = tuple(_fetch_from_env(env, n) for n in fetch_names)
+            new_state = {n: env[n] for n in state_keys if n in env}
+            # newly-created persistable values (startup initializers)
+            for n in persistable_names:
+                if n not in new_state and n in env:
+                    new_state[n] = env[n]
+            return fetches, new_state
+
+        return step
+
+    @staticmethod
+    def _lower_with_grad(ctx, ops, bwd_idx, program, block):
+        """Trace forward ops under value_and_grad, bind param@GRAD vars, then
+        trace the remaining (optimizer) ops."""
+        marker = ops[bwd_idx]
+        if marker.type == "backward_marker":
+            wrt_names = marker.attr("param_names") or []
+            target_names = [marker.attr("loss_name")]
+        else:  # calc_gradient_marker
+            wrt_names = marker.attr("input_names") or []
+            target_names = marker.attr("target_names") or []
+        base_env = dict(ctx.env)
+        wrt = {n: base_env[n] for n in wrt_names if n in base_env}
+
+        def forward(params):
+            env = dict(base_env)
+            env.update(params)
+            fctx = registry.LowerContext(env, ctx._rng_fn,
+                                         is_test=ctx.is_test,
+                                         executor=ctx.executor, block=block)
+            for op in ops[:bwd_idx]:
+                _lower_op(fctx, op)
+            # scalar objective: mean-reduce each target (loss is already
+            # scalar in the common case; calc_gradient uses unit cotangents,
+            # i.e. sum of each target's elements)
+            total = 0.0
+            for tn in target_names:
+                t = env[tn]
+                total = total + (t if t.ndim == 0 else jnp.sum(t))
+            return total, env
+
+        (loss_val, env_after), grads = jax.value_and_grad(
+            forward, has_aux=True)(wrt)
+        ctx.env.update(env_after)
+        if marker.type == "backward_marker":
+            ctx.env[target_names[0] + "@GRAD"] = jnp.ones_like(loss_val)
+        for p, g in grads.items():
+            ctx.env[p + "@GRAD"] = g
+        for op in ops[bwd_idx + 1:]:
+            _lower_op(ctx, op)
+
+    @staticmethod
+    def _check_nan_inf(names, values):
+        # FLAGS_check_nan_inf parity (reference executor.cc:27-94).
+        for n, v in zip(names, values):
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                raise FloatingPointError(
+                    "NaN/Inf detected in fetched var %r" % n)
+
+
+def _lower_op(ctx, op):
+    if op.type in ("feed", "fetch"):
+        _lower_feed_fetch(ctx, op)
+        return
+    info = registry.lookup(op.type)
+    if info is None:
+        raise NotImplementedError(
+            "no TPU lowering registered for op %r (registered: %d ops)"
+            % (op.type, len(registry.registered_ops())))
+    info.lower(ctx, op)
+
+
+def _lower_feed_fetch(ctx, op):
+    # Feeds are pre-bound into env by var name; a 'feed' op in a loaded
+    # inference program is therefore a name passthrough, as is 'fetch'.
+    if op.type == "feed":
+        out = ctx.out_name(op, "Out")
+        if out is not None and out not in ctx.env:
+            raise KeyError("feed target %r was not provided in feed dict" % out)
+    else:  # fetch
+        src = op.input("X")
+        out = ctx.out_name(op, "Out")
+        if src and out:
+            ctx.env[out] = ctx.get(src[0])
+
+
+def _fetch_from_env(env, name):
+    if name not in env:
+        raise KeyError(
+            "fetch var %r was not produced by the program; "
+            "available: %s..." % (name, sorted(env)[:20]))
+    return env[name]

@@ -1,0 +1,99 @@
+"""Op lowering registry.
+
+This replaces the reference's kernel registry (paddle/fluid/framework/
+op_registry.h:52-129 + per-device kernels): instead of CPU/CUDA kernel
+functions selected at interpreter time, each op type registers a *lowering
+rule* — a pure function from jax values to jax values — that the Executor's
+tracer calls while staging the whole Program into one XLA computation.
+
+An op therefore needs no per-device variants: XLA compiles the same lowering
+for TPU and CPU. Pallas kernels slot in as lowering bodies for ops where XLA
+fusion is insufficient (attention etc.).
+"""
+
+import jax.numpy as jnp
+
+
+class OpInfo:
+    def __init__(self, type, lower, infer_shape=None, stateful_rng=False):
+        self.type = type
+        self.lower = lower            # fn(ctx, op) -> None (writes ctx env)
+        self.infer_shape = infer_shape
+        self.stateful_rng = stateful_rng  # consumes a PRNG key at trace time
+
+
+_REGISTRY = {}
+
+
+def register(type, lower=None, infer_shape=None, stateful_rng=False):
+    """Register an op lowering. Usable as decorator or direct call."""
+    def deco(fn):
+        _REGISTRY[type] = OpInfo(type, fn, infer_shape, stateful_rng)
+        return fn
+    if lower is not None:
+        return deco(lower)
+    return deco
+
+
+def lookup(type):
+    return _REGISTRY.get(type)
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+class LowerContext:
+    """Environment handed to lowering rules during tracing.
+
+    env maps var name -> jax value. Replaces the reference's ExecutionContext
+    (scope lookup + device context); there is no device context because
+    placement is XLA's job.
+    """
+
+    def __init__(self, env, rng_fn, is_test=False, executor=None, block=None,
+                 mesh=None):
+        self.env = env
+        self._rng_fn = rng_fn      # () -> fresh jax PRNG key
+        self.is_test = is_test
+        self.executor = executor
+        self.block = block
+        self.mesh = mesh
+
+    # -- value access --------------------------------------------------------
+    def get(self, name):
+        if name not in self.env:
+            raise KeyError("var %r not materialized at lowering time" % name)
+        return self.env[name]
+
+    def maybe_get(self, name, default=None):
+        return self.env.get(name, default)
+
+    def set(self, name, value):
+        self.env[name] = value
+
+    def in1(self, op, slot, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        return self.get(names[0])
+
+    def in_list(self, op, slot):
+        return [self.get(n) for n in op.input(slot)]
+
+    def out_name(self, op, slot):
+        names = op.output(slot)
+        return names[0] if names else None
+
+    def set_out(self, op, slot, value):
+        name = self.out_name(op, slot)
+        if name is not None:
+            self.env[name] = value
+
+    def rng(self):
+        return self._rng_fn()
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def cast_like(x, ref):
+        return jnp.asarray(x, dtype=ref.dtype)

@@ -1,0 +1,92 @@
+"""Scope: hierarchical name → value store for runtime state.
+
+Parity with paddle/fluid/framework/scope.h:39 (Var/FindVar/NewScope), but the
+stored values are host numpy arrays or committed jax.Arrays rather than
+C++ Variables: persistable state (parameters, optimizer accumulators) lives
+here between compiled steps, and the Executor threads it through the jitted
+step function as donated inputs/outputs.
+"""
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self.parent = parent
+        self._vars = {}      # name -> value (np.ndarray | jax.Array | LoDTensor | py obj)
+        self._kids = []
+
+    # -- reference API -------------------------------------------------------
+    def var(self, name):
+        """Find-or-create (returns current value holder name)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def new_scope(self):
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    # -- value access --------------------------------------------------------
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def get(self, name, default=None):
+        v = self.find_var(name)
+        return default if v is None else v
+
+    def get_numpy(self, name):
+        v = self.find_var(name)
+        if v is None:
+            return None
+        return np.asarray(v)
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def __contains__(self, name):
+        return self.has_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        old, _global_scope = _global_scope, scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+    return guard()

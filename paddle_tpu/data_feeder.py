@@ -1,0 +1,64 @@
+"""DataFeeder: minibatch rows → feed dict.
+
+Reference parity: python/paddle/fluid/data_feeder.py:69 — converts a list of
+sample tuples (one element per feed var) into arrays/LoDTensors keyed by var
+name. LoD-level>0 vars become padded arrays + `<name>@LOD` length vectors
+(the TPU static-shape representation, see core/lod.py).
+"""
+
+import numpy as np
+
+from .core.lod import LoDTensor, pack_sequences
+from .core.program import Variable, convert_dtype, default_main_program
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.place = place
+        program = program or default_main_program()
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            self.feed_vars.append(v)
+
+    def feed(self, iterable):
+        """iterable: list of sample tuples. Returns {var name: array|LoDTensor}."""
+        columns = list(zip(*iterable)) if iterable else \
+            [[] for _ in self.feed_vars]
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            dtype = np.dtype(convert_dtype(var.dtype))
+            if var.lod_level and var.lod_level > 0:
+                seqs = [np.asarray(s, dtype=dtype) for s in col]
+                # reference shape convention: sequence features often [Ti] ids
+                # or [Ti, D]; pad to [B, Tmax, ...] and attach lengths
+                if seqs and seqs[0].ndim == 0:
+                    seqs = [s.reshape(1) for s in seqs]
+                padded, lengths = pack_sequences(seqs, dtype=dtype)
+                t = LoDTensor(padded)
+                t.set_recursive_sequence_lengths([list(map(int, lengths))])
+                out[var.name] = t
+            else:
+                arr = np.asarray(col, dtype=dtype)
+                shape = var.shape
+                if shape is not None:
+                    want = [len(col)] + [int(s) for s in shape[1:]]
+                    if -1 not in want and list(arr.shape) != want:
+                        arr = arr.reshape(want)
+                    elif arr.ndim == 1 and len(shape) > 1:
+                        arr = arr.reshape(len(col), -1)
+                out[var.name] = arr
+        return out
+
+    def feed_parallel(self, iterable, num_places):
+        """Split one batch into per-device sub-batches (SplitLoDTensor
+        equivalent for the data-parallel executor)."""
+        full = self.feed(iterable)
+        outs = [dict() for _ in range(num_places)]
+        for name, val in full.items():
+            arr = val.data if isinstance(val, LoDTensor) else val
+            chunks = np.array_split(arr, num_places)
+            for i, c in enumerate(chunks):
+                outs[i][name] = c
+        return outs

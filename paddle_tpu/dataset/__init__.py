@@ -1,0 +1,4 @@
+"""Built-in datasets (synthetic, egress-free) — parity with
+python/paddle/dataset/ (15 datasets; see each module)."""
+
+from . import common, mnist  # noqa: F401

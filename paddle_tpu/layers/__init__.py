@@ -1,0 +1,17 @@
+"""fluid.layers-compatible DSL surface."""
+
+from . import ops  # noqa: F401
+from .io_ops import data  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .nn import (  # noqa: F401
+    accuracy, auc, batch_norm, cross_entropy, dropout, embedding, fc,
+    layer_norm, matmul, mean, one_hot, reduce_max, reduce_mean, reduce_min,
+    reduce_prod, reduce_sum, softmax, softmax_with_cross_entropy,
+    square_error_cost, topk,
+)
+from .ops import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    argmax, argmin, assign, cast, concat, create_global_var, create_tensor,
+    expand, fill_constant, fill_constant_batch_size_like, gather, increment,
+    ones, reshape, scatter, split, sums, transpose, zeros,
+)

@@ -1,0 +1,13 @@
+"""Op lowering registry population: importing this package registers all op
+lowerings (the analog of the reference's static REGISTER_OPERATOR blocks)."""
+
+from . import (  # noqa: F401
+    activations,
+    elementwise,
+    loss,
+    math,
+    metrics_ops,
+    nn,
+    optimizer_ops,
+    tensor_ops,
+)

@@ -1,0 +1,79 @@
+"""Activation ops — parity with operators/activation_op.cc (30 activations).
+
+All are single jnp/lax expressions; XLA fuses them into producers so there is
+no standalone-kernel cost like the reference's CUDA functors.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _unary(fn):
+    def lower(ctx, op):
+        ctx.set_out(op, "Out", fn(ctx.in1(op, "X"), op))
+    return lower
+
+
+_SIMPLE = {
+    "sigmoid": lambda x, op: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, op: jax.nn.log_sigmoid(x),
+    "exp": lambda x, op: jnp.exp(x),
+    "relu": lambda x, op: jax.nn.relu(x),
+    "tanh": lambda x, op: jnp.tanh(x),
+    "tanh_shrink": lambda x, op: x - jnp.tanh(x),
+    "sqrt": lambda x, op: jnp.sqrt(x),
+    "rsqrt": lambda x, op: jax.lax.rsqrt(x),
+    "abs": lambda x, op: jnp.abs(x),
+    "ceil": lambda x, op: jnp.ceil(x),
+    "floor": lambda x, op: jnp.floor(x),
+    "cos": lambda x, op: jnp.cos(x),
+    "sin": lambda x, op: jnp.sin(x),
+    "round": lambda x, op: jnp.round(x),
+    "reciprocal": lambda x, op: 1.0 / x,
+    "log": lambda x, op: jnp.log(x),
+    "square": lambda x, op: jnp.square(x),
+    "softplus": lambda x, op: jax.nn.softplus(x),
+    "softsign": lambda x, op: jax.nn.soft_sign(x),
+    "sign": lambda x, op: jnp.sign(x),
+    "gelu": lambda x, op: jax.nn.gelu(
+        x, approximate=bool(op.attr("approximate", False))),
+    "erf": lambda x, op: jax.scipy.special.erf(x),
+    "silu": lambda x, op: jax.nn.silu(x),
+    "brelu": lambda x, op: jnp.clip(
+        x, op.attr("t_min", 0.0), op.attr("t_max", 24.0)),
+    "leaky_relu": lambda x, op: jax.nn.leaky_relu(
+        x, op.attr("alpha", 0.02)),
+    "soft_relu": lambda x, op: jnp.log1p(
+        jnp.exp(jnp.clip(x, -op.attr("threshold", 40.0),
+                         op.attr("threshold", 40.0)))),
+    "elu": lambda x, op: jax.nn.elu(x, op.attr("alpha", 1.0)),
+    "relu6": lambda x, op: jnp.clip(x, 0.0, op.attr("threshold", 6.0)),
+    "pow": lambda x, op: jnp.power(x, op.attr("factor", 1.0)),
+    "stanh": lambda x, op: op.attr("scale_b", 1.7159)
+        * jnp.tanh(op.attr("scale_a", 2.0 / 3.0) * x),
+    "hard_shrink": lambda x, op: jnp.where(
+        jnp.abs(x) > op.attr("threshold", 0.5), x, 0.0),
+    "softshrink": lambda x, op: jnp.sign(x) * jax.nn.relu(
+        jnp.abs(x) - op.attr("lambda", 0.5)),
+    "thresholded_relu": lambda x, op: jnp.where(
+        x > op.attr("threshold", 1.0), x, 0.0),
+    "hard_sigmoid": lambda x, op: jnp.clip(
+        op.attr("slope", 0.2) * x + op.attr("offset", 0.5), 0.0, 1.0),
+    "swish": lambda x, op: x * jax.nn.sigmoid(op.attr("beta", 1.0) * x),
+    "mish": lambda x, op: x * jnp.tanh(jax.nn.softplus(x)),
+}
+
+for _name, _fn in _SIMPLE.items():
+    register(_name, _unary(_fn))
+
+
+@register("prelu")
+def _prelu(ctx, op):
+    x = ctx.in1(op, "X")
+    alpha = ctx.in1(op, "Alpha")
+    mode = op.attr("mode", "all")
+    if mode == "channel" and alpha.ndim == 1 and x.ndim == 4:
+        alpha = alpha.reshape(1, -1, 1, 1)
+    ctx.set_out(op, "Out", jnp.where(x > 0, x, alpha * x))

@@ -1,0 +1,80 @@
+"""Elementwise binary ops, comparison and logical ops.
+
+Reference parity: paddle/fluid/operators/elementwise_*.cc (add/sub/mul/div/
+max/min/pow with `axis` mid-dimension broadcast), compare_op.cc, logical_op.cc.
+Each lowers to one jnp call; XLA fuses chains of these into neighboring
+matmuls/convs, which is what the reference needed hand-written fused kernels
+for.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _broadcast_y(x, y, axis):
+    """Reference broadcast rule: Y's dims align to X starting at `axis`
+    (elementwise_op_function.h). axis == -1 → trailing alignment."""
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis is None or axis == -1:
+        return y  # numpy trailing broadcast
+    axis = int(axis)
+    new_shape = (1,) * axis + tuple(y.shape) + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+    "elementwise_div": jnp.divide,
+    "elementwise_max": jnp.maximum,
+    "elementwise_min": jnp.minimum,
+    "elementwise_pow": jnp.power,
+    "elementwise_mod": jnp.mod,
+    "elementwise_floordiv": jnp.floor_divide,
+}
+
+_COMPARE = {
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+}
+
+_LOGICAL_BIN = {
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+
+
+def _make_binary(fn, cast_bool=False):
+    def lower(ctx, op):
+        x = ctx.in1(op, "X")
+        y = ctx.in1(op, "Y")
+        y = _broadcast_y(x, y, op.attr("axis", -1))
+        out = fn(x, y)
+        scale = op.attr("scale")  # fused scale support (elementwise add_op)
+        if scale is not None and scale != 1.0:
+            out = out * scale
+        ctx.set_out(op, "Out", out)
+    return lower
+
+
+for _name, _fn in _BINARY.items():
+    register(_name, _make_binary(_fn))
+
+for _name, _fn in _COMPARE.items():
+    register(_name, _make_binary(_fn))
+
+for _name, _fn in _LOGICAL_BIN.items():
+    register(_name, _make_binary(_fn))
+
+
+@register("logical_not")
+def _logical_not(ctx, op):
+    ctx.set_out(op, "Out", jnp.logical_not(ctx.in1(op, "X")))

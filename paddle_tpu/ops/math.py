@@ -1,0 +1,227 @@
+"""Core math ops: mul/matmul (MXU path), reductions, scale, norms.
+
+Reference parity: operators/mul_op.cc (x_num_col_dims flattening),
+matmul_op.cc (batched + transpose flags), sum_op, mean_op, scale_op,
+clip/clip_by_norm, reduce_op.cc family, cumsum, l1/l2 norms, cos_sim,
+bilinear_tensor_product, top_k.
+
+Matmuls accumulate in float32 via preferred_element_type so bf16 inputs use
+the MXU at full throughput without losing accumulation precision.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+def _acc_type(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+def _flatten2d(x, num_col_dims):
+    lead = 1
+    for s in x.shape[:num_col_dims]:
+        lead *= s
+    return x.reshape(lead, -1), x.shape
+
+
+@register("mul")
+def _mul(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    xn = op.attr("x_num_col_dims", 1)
+    yn = op.attr("y_num_col_dims", 1)
+    x2, xshape = _flatten2d(x, xn)
+    y2 = y.reshape(functools.reduce(lambda a, b: a * b, y.shape[:yn], 1), -1)
+    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
+    out = out.astype(x.dtype)
+    out = out.reshape(xshape[:xn] + y.shape[yn:])
+    ctx.set_out(op, "Out", out)
+
+
+@register("matmul")
+def _matmul(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    if op.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if op.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    out = out.astype(x.dtype)
+    alpha = op.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_out(op, "Out", out)
+
+
+@register("sum")
+def _sum(ctx, op):
+    xs = ctx.in_list(op, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_out(op, "Out", out)
+
+
+@register("mean")
+def _mean(ctx, op):
+    ctx.set_out(op, "Out", jnp.mean(ctx.in1(op, "X")))
+
+
+@register("scale")
+def _scale(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = op.attr("scale", 1.0)
+    bias = op.attr("bias", 0.0)
+    if op.attr("bias_after_scale", True):
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    ctx.set_out(op, "Out", out)
+
+
+@register("clip")
+def _clip(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out",
+                jnp.clip(x, op.attr("min", -1.0), op.attr("max", 1.0)))
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    max_norm = op.attr("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    ctx.set_out(op, "Out",
+                jnp.where(norm > max_norm, x * (max_norm / norm), x))
+
+
+def _reduce(fn):
+    def lower(ctx, op):
+        x = ctx.in1(op, "X")
+        dim = op.attr("dim", [0])
+        if op.attr("reduce_all", False):
+            axes = None
+        else:
+            if isinstance(dim, int):
+                dim = [dim]
+            axes = tuple(d % x.ndim for d in dim)
+        out = fn(x, axis=axes, keepdims=op.attr("keep_dim", False))
+        ctx.set_out(op, "Out", out)
+    return lower
+
+
+register("reduce_sum", _reduce(jnp.sum))
+register("reduce_mean", _reduce(jnp.mean))
+register("reduce_max", _reduce(jnp.max))
+register("reduce_min", _reduce(jnp.min))
+register("reduce_prod", _reduce(jnp.prod))
+
+
+@register("cumsum")
+def _cumsum(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = op.attr("axis", -1)
+    out = jnp.cumsum(x, axis=axis)
+    if op.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if op.attr("exclusive", False):
+        out = out - x
+    ctx.set_out(op, "Out", out)
+
+
+@register("l1_norm")
+def _l1_norm(ctx, op):
+    ctx.set_out(op, "Out", jnp.sum(jnp.abs(ctx.in1(op, "X"))))
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, op):
+    ctx.set_out(op, "Out", jnp.sum(jnp.square(ctx.in1(op, "X"))))
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    d = x - y
+    ctx.set_out(op, "sub_result", d)
+    ctx.set_out(op, "Out", jnp.sum(jnp.square(d), axis=-1, keepdims=True))
+
+
+@register("norm")
+def _norm(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = op.attr("axis", 1)
+    eps = op.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    ctx.set_out(op, "Norm", norm)
+    ctx.set_out(op, "Out", x / norm)
+
+
+@register("cos_sim")
+def _cos_sim(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "XNorm", xn)
+    ctx.set_out(op, "YNorm", yn)
+
+
+@register("bilinear_tensor_product")
+def _bilinear(ctx, op):
+    x = ctx.in1(op, "X")          # [B, M]
+    y = ctx.in1(op, "Y")          # [B, N]
+    w = ctx.in1(op, "Weight")     # [O, M, N]
+    out = jnp.einsum("bm,omn,bn->bo", x, w, y)
+    b = ctx.in1(op, "Bias")
+    if b is not None:
+        out = out + b
+    ctx.set_out(op, "Out", out)
+
+
+@register("top_k")
+def _top_k(ctx, op):
+    x = ctx.in1(op, "X")
+    k = op.attr("k", 1)
+    vals, idx = lax.top_k(x, k)
+    ctx.set_out(op, "Out", vals)
+    ctx.set_out(op, "Indices", idx.astype(jnp.int64))
+
+
+@register("arg_max")
+def _arg_max(ctx, op):
+    ctx.set_out(op, "Out", jnp.argmax(
+        ctx.in1(op, "X"), axis=op.attr("axis", -1)).astype(jnp.int64))
+
+
+@register("arg_min")
+def _arg_min(ctx, op):
+    ctx.set_out(op, "Out", jnp.argmin(
+        ctx.in1(op, "X"), axis=op.attr("axis", -1)).astype(jnp.int64))
+
+
+@register("minus")
+def _minus(ctx, op):
+    ctx.set_out(op, "Out", ctx.in1(op, "X") - ctx.in1(op, "Y"))
+
+
+@register("conv_shift")
+def _conv_shift(ctx, op):
+    # circular correlation (operators/conv_shift_op.cc)
+    x = ctx.in1(op, "X")          # [B, M]
+    y = ctx.in1(op, "Y")          # [B, N], N odd, N <= M
+    m = x.shape[1]
+    n = y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    gathered = x[:, idx]                     # [B, M, N]
+    ctx.set_out(op, "Out", jnp.einsum("bmn,bn->bm", gathered, y))

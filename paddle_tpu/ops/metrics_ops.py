@@ -1,0 +1,128 @@
+"""Metric ops — parity with operators/{accuracy,auc,precision_recall,
+edit_distance}_op.cc. These run inside the compiled step (per-batch values);
+streaming accumulation lives in paddle_tpu/metrics.py like the reference's
+python-side fluid.metrics.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register("accuracy")
+def _accuracy(ctx, op):
+    indices = ctx.in1(op, "Indices")      # [N, k]
+    label = ctx.in1(op, "Label")          # [N, 1] or [N]
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label.reshape(-1)
+    hit = jnp.any(indices == label[:, None].astype(indices.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int64))
+    total = jnp.asarray(label.shape[0], jnp.int64)
+    ctx.set_out(op, "Accuracy",
+                (correct.astype(jnp.float32) / total.astype(jnp.float32)
+                 ).reshape(1))
+    ctx.set_out(op, "Correct", correct.reshape(1))
+    ctx.set_out(op, "Total", total.reshape(1))
+
+
+@register("auc")
+def _auc(ctx, op):
+    """Batch AUC by threshold bucketing (operators/auc_op.cc semantics)."""
+    preds = ctx.in1(op, "Out")            # [N, 2] probs or [N]
+    label = ctx.in1(op, "Label")
+    if preds.ndim == 2 and preds.shape[1] >= 2:
+        pos_score = preds[:, 1]
+    else:
+        pos_score = preds.reshape(-1)
+    label = label.reshape(-1).astype(jnp.float32)
+    num_t = op.attr("num_thresholds", 200)
+    thresholds = jnp.linspace(0.0, 1.0, num_t)
+    pred_pos = pos_score[None, :] > thresholds[:, None]     # [T, N]
+    tp = jnp.sum(pred_pos * label[None, :], axis=1)
+    fp = jnp.sum(pred_pos * (1 - label[None, :]), axis=1)
+    pos = jnp.sum(label)
+    neg = label.shape[0] - pos
+    tpr = tp / jnp.maximum(pos, 1.0)
+    fpr = fp / jnp.maximum(neg, 1.0)
+    # trapezoid over decreasing fpr
+    auc = -jnp.trapezoid(tpr, fpr)
+    ctx.set_out(op, "AUC", auc.reshape(1))
+
+
+@register("precision_recall")
+def _precision_recall(ctx, op):
+    indices = ctx.in1(op, "Indices")
+    label = ctx.in1(op, "Labels").reshape(-1)
+    cls = op.attr("class_number")
+    pred = indices.reshape(-1).astype(jnp.int32)
+    label = label.astype(jnp.int32)
+    oh_pred = jnp.eye(cls, dtype=jnp.float32)[pred]
+    oh_lab = jnp.eye(cls, dtype=jnp.float32)[label]
+    tp = jnp.sum(oh_pred * oh_lab, axis=0)
+    fp = jnp.sum(oh_pred * (1 - oh_lab), axis=0)
+    fn = jnp.sum((1 - oh_pred) * oh_lab, axis=0)
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    recall = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-6)
+    macro = jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)])
+    tp_a, fp_a, fn_a = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    micro_p = tp_a / jnp.maximum(tp_a + fp_a, 1.0)
+    micro_r = tp_a / jnp.maximum(tp_a + fn_a, 1.0)
+    micro_f = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-6)
+    micro = jnp.stack([micro_p, micro_r, micro_f])
+    ctx.set_out(op, "BatchMetrics", jnp.concatenate([macro, micro]))
+    ctx.set_out(op, "AccumStatesInfo",
+                jnp.stack([tp, fp, fn], axis=1))
+
+
+@register("edit_distance")
+def _edit_distance(ctx, op):
+    """Levenshtein distance between padded int sequences (operators/
+    edit_distance_op.cc). Uses a scan over the DP table rows — static shapes
+    keep it XLA-friendly."""
+    import jax
+    from jax import lax
+    hyp = ctx.in1(op, "Hyps")
+    ref = ctx.in1(op, "Refs")
+    hyp_lod = ctx.maybe_get(op.input("Hyps")[0] + "@LOD")
+    ref_lod = ctx.maybe_get(op.input("Refs")[0] + "@LOD")
+    if hyp.ndim == 2 and hyp.shape[-1] == 1:
+        hyp = hyp[..., 0][None, :] if hyp_lod is None else hyp[..., 0]
+    if ref.ndim == 2 and ref.shape[-1] == 1:
+        ref = ref[..., 0][None, :] if ref_lod is None else ref[..., 0]
+    if hyp.ndim == 1:
+        hyp = hyp[None, :]
+    if ref.ndim == 1:
+        ref = ref[None, :]
+
+    def one_pair(h, r, hl, rl):
+        m, n = h.shape[0], r.shape[0]
+        row0 = jnp.arange(n + 1, dtype=jnp.float32)
+
+        def step(prev_row, i):
+            def inner(carry, j):
+                left = carry
+                diag = prev_row[j]
+                up = prev_row[j + 1]
+                cost = jnp.where(h[i] == r[j], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(up + 1, left + 1), diag + cost)
+                val = jnp.where(j < rl, val, left)
+                return val, val
+            first = prev_row[0] + 1
+            _, rest = lax.scan(inner, first, jnp.arange(n))
+            row = jnp.concatenate([first[None], rest])
+            row = jnp.where(i < hl, row, prev_row)
+            return row, None
+
+        final, _ = lax.scan(step, row0, jnp.arange(m))
+        return final[rl]
+
+    hls = (hyp_lod if hyp_lod is not None
+           else jnp.full((hyp.shape[0],), hyp.shape[1]))
+    rls = (ref_lod if ref_lod is not None
+           else jnp.full((ref.shape[0],), ref.shape[1]))
+    dists = jax.vmap(one_pair)(hyp, ref, hls, rls)
+    if op.attr("normalized", True):
+        dists = dists / jnp.maximum(rls.astype(jnp.float32), 1.0)
+    ctx.set_out(op, "Out", dists.reshape(-1, 1))
+    ctx.set_out(op, "SequenceNum", jnp.asarray([hyp.shape[0]], jnp.int64))

@@ -1,0 +1,174 @@
+"""NN ops: softmax, dropout, normalization.
+
+Reference parity: operators/{softmax,dropout,batch_norm,layer_norm,lrn,
+maxout}_op.cc. batch_norm keeps running stats as persistable state threaded
+through the step function (the reference mutates scope vars in-place;
+functional state threading is the XLA equivalent).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register("softmax")
+def _softmax(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jax.nn.softmax(x, axis=-1))
+
+
+@register("log_softmax")
+def _log_softmax(ctx, op):
+    ctx.set_out(op, "Out", jax.nn.log_softmax(ctx.in1(op, "X"), axis=-1))
+
+
+@register("sequence_softmax")
+def _sequence_softmax(ctx, op):
+    # softmax over each sequence segment; lengths come in via <X>@LOD
+    x = ctx.in1(op, "X")
+    lod_name = op.input("X")[0] + "@LOD"
+    lengths = ctx.maybe_get(lod_name)
+    if lengths is None:
+        ctx.set_out(op, "Out", jax.nn.softmax(x.reshape(-1), axis=0).reshape(x.shape))
+        return
+    # segment softmax on flattened [T] data
+    seg = _lengths_to_segments(lengths, x.shape[0])
+    flat = x.reshape(x.shape[0])
+    m = jax.ops.segment_max(flat, seg, num_segments=lengths.shape[0])
+    e = jnp.exp(flat - m[seg])
+    s = jax.ops.segment_sum(e, seg, num_segments=lengths.shape[0])
+    ctx.set_out(op, "Out", (e / s[seg]).reshape(x.shape))
+
+
+def _lengths_to_segments(lengths, total):
+    ends = jnp.cumsum(lengths)
+    return jnp.searchsorted(ends, jnp.arange(total), side="right")
+
+
+@register("dropout", stateful_rng=True)
+def _dropout(ctx, op):
+    x = ctx.in1(op, "X")
+    p = op.attr("dropout_prob", 0.5)
+    is_test = op.attr("is_test", False) or ctx.is_test
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test or p == 0.0:
+        # downgrade_in_infer scales at inference time (reference default)
+        out = x * (1.0 - p) if (impl == "downgrade_in_infer" and p > 0.0) \
+            else x
+        ctx.set_out(op, "Out", out)
+        ctx.set_out(op, "Mask", jnp.ones_like(x))
+        return
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(ctx.rng(), keep, x.shape).astype(x.dtype)
+    ctx.set_out(op, "Mask", mask)
+    if impl == "upscale_in_train":
+        ctx.set_out(op, "Out", x * mask / keep)
+    else:
+        ctx.set_out(op, "Out", x * mask)
+
+
+@register("batch_norm")
+def _batch_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = ctx.in1(op, "Scale")
+    bias = ctx.in1(op, "Bias")
+    mean_in = ctx.in1(op, "Mean")
+    var_in = ctx.in1(op, "Variance")
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    layout = op.attr("data_layout", "NCHW")
+    is_test = op.attr("is_test", False) or ctx.is_test
+
+    ch_axis = 1 if layout == "NCHW" and x.ndim > 1 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if is_test:
+        mean, var = mean_in, var_in
+    else:
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        new_mean = momentum * mean_in + (1 - momentum) * mean
+        new_var = momentum * var_in + (1 - momentum) * var
+        ctx.set_out(op, "MeanOut", new_mean)
+        ctx.set_out(op, "VarianceOut", new_var)
+        ctx.set_out(op, "SavedMean", mean)
+        ctx.set_out(op, "SavedVariance", 1.0 / jnp.sqrt(var + eps))
+        # MeanOut/VarianceOut alias Mean/Variance in the reference; keep the
+        # state var updated under its own name too.
+        min_names = op.input("Mean")
+        vin_names = op.input("Variance")
+        if min_names:
+            ctx.env[min_names[0]] = jax.lax.stop_gradient(new_mean)
+        if vin_names:
+            ctx.env[vin_names[0]] = jax.lax.stop_gradient(new_var)
+
+    inv = jax.lax.rsqrt(var + eps)
+    out = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = out * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_out(op, "Y", out)
+
+
+@register("layer_norm")
+def _layer_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = ctx.in1(op, "Scale")
+    bias = ctx.in1(op, "Bias")
+    eps = op.attr("epsilon", 1e-5)
+    begin = op.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale.reshape((1,) * begin + x.shape[begin:])
+    if bias is not None:
+        out = out + bias.reshape((1,) * begin + x.shape[begin:])
+    ctx.set_out(op, "Y", out)
+    ctx.set_out(op, "Mean", mean.reshape(x.shape[:begin]))
+    ctx.set_out(op, "Variance", var.reshape(x.shape[:begin]))
+
+
+@register("lrn")
+def _lrn(ctx, op):
+    x = ctx.in1(op, "X")                 # NCHW
+    n = op.attr("n", 5)
+    k = op.attr("k", 2.0)
+    alpha = op.attr("alpha", 1e-4)
+    beta = op.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    ctx.set_out(op, "MidOut", mid)
+    ctx.set_out(op, "Out", x / jnp.power(mid, beta))
+
+
+@register("maxout")
+def _maxout(ctx, op):
+    x = ctx.in1(op, "X")                 # [N, C, H, W]
+    groups = op.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_out(op, "Out",
+                x.reshape(n, c // groups, groups, h, w).max(axis=2))
+
+
+@register("im2sequence")
+def _im2sequence(ctx, op):
+    """Image → sequence of flattened patches (operators/im2sequence_op.cc)."""
+    x = ctx.in1(op, "X")                 # [N, C, H, W]
+    kh, kw = op.attr("kernels", [1, 1])
+    sh, sw = op.attr("strides", [1, 1])
+    pads = op.attr("paddings", [0, 0, 0, 0])
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [N, C*kh*kw, oh, ow]
+    seq = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    ctx.set_out(op, "Out", seq)

@@ -1,0 +1,246 @@
+"""Optimizer ops.
+
+Reference parity: operators/{sgd,momentum,adagrad,adam,adamax,decayed_adagrad,
+adadelta,rmsprop,ftrl,proximal_gd,proximal_adagrad}_op.cc.
+
+The reference updates Param in-place in the scope. Here each op writes the
+updated value back into the env under the *input* var's name (as well as the
+declared output slot), so the Executor's state threading commits it — with
+buffer donation this compiles to a true in-place update on device.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _upd(ctx, op, slot_in, slot_out, value):
+    names = op.input(slot_in)
+    if names:
+        ctx.env[names[0]] = value
+    out = op.output(slot_out)
+    if out:
+        ctx.env[out[0]] = value
+
+
+@register("sgd")
+def _sgd(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    lr = ctx.in1(op, "LearningRate")
+    _upd(ctx, op, "Param", "ParamOut", p - lr * g)
+
+
+@register("momentum")
+def _momentum(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    v = ctx.in1(op, "Velocity")
+    lr = ctx.in1(op, "LearningRate")
+    mu = op.attr("mu", 0.9)
+    v_new = mu * v + g
+    if op.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    _upd(ctx, op, "Velocity", "VelocityOut", v_new)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+
+
+@register("adagrad")
+def _adagrad(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    m = ctx.in1(op, "Moment")
+    lr = ctx.in1(op, "LearningRate")
+    eps = op.attr("epsilon", 1e-6)
+    m_new = m + g * g
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    _upd(ctx, op, "Moment", "MomentOut", m_new)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+
+
+@register("adam")
+def _adam(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    m1 = ctx.in1(op, "Moment1")
+    m2 = ctx.in1(op, "Moment2")
+    lr = ctx.in1(op, "LearningRate")
+    b1p = ctx.in1(op, "Beta1Pow")
+    b2p = ctx.in1(op, "Beta2Pow")
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    _upd(ctx, op, "Moment1", "Moment1Out", m1n)
+    _upd(ctx, op, "Moment2", "Moment2Out", m2n)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+    # Beta pow accumulators updated by the caller-side scale op in the
+    # reference (optimizer.py); here we advance them with the op itself when
+    # this op is the designated "last" one (attr set by Optimizer).
+    if op.attr("update_beta_pow", False):
+        _upd(ctx, op, "Beta1Pow", "Beta1PowOut", b1p * b1)
+        _upd(ctx, op, "Beta2Pow", "Beta2PowOut", b2p * b2)
+
+
+@register("adamax")
+def _adamax(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    m = ctx.in1(op, "Moment")
+    inf_norm = ctx.in1(op, "InfNorm")
+    lr = ctx.in1(op, "LearningRate")
+    b1p = ctx.in1(op, "Beta1Pow")
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    p_new = p - (lr / (1 - b1p)) * m_new / inf_new
+    _upd(ctx, op, "Moment", "MomentOut", m_new)
+    _upd(ctx, op, "InfNorm", "InfNormOut", inf_new)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+    if op.attr("update_beta_pow", False):
+        _upd(ctx, op, "Beta1Pow", "Beta1PowOut", b1p * b1)
+
+
+@register("decayed_adagrad")
+def _decayed_adagrad(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    m = ctx.in1(op, "Moment")
+    lr = ctx.in1(op, "LearningRate")
+    decay = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * g * g
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    _upd(ctx, op, "Moment", "MomentOut", m_new)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+
+
+@register("adadelta")
+def _adadelta(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    avg_sq_grad = ctx.in1(op, "AvgSquaredGrad")
+    avg_sq_upd = ctx.in1(op, "AvgSquaredUpdate")
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    asg = rho * avg_sq_grad + (1 - rho) * g * g
+    upd = -jnp.sqrt((avg_sq_upd + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_upd + (1 - rho) * upd * upd
+    _upd(ctx, op, "AvgSquaredGrad", "AvgSquaredGradOut", asg)
+    _upd(ctx, op, "AvgSquaredUpdate", "AvgSquaredUpdateOut", asu)
+    _upd(ctx, op, "Param", "ParamOut", p + upd)
+
+
+@register("rmsprop")
+def _rmsprop(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    ms = ctx.in1(op, "MeanSquare")
+    mom = ctx.in1(op, "Moment")
+    lr = ctx.in1(op, "LearningRate")
+    rho = op.attr("decay", 0.9)
+    eps = op.attr("epsilon", 1e-10)
+    momentum = op.attr("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * g * g
+    mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
+    _upd(ctx, op, "MeanSquare", "MeanSquareOut", ms_new)
+    _upd(ctx, op, "Moment", "MomentOut", mom_new)
+    _upd(ctx, op, "Param", "ParamOut", p - mom_new)
+
+
+@register("ftrl")
+def _ftrl(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    sq = ctx.in1(op, "SquaredAccumulator")
+    lin = ctx.in1(op, "LinearAccumulator")
+    lr = ctx.in1(op, "LearningRate")
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    power = op.attr("lr_power", -0.5)
+    sq_new = sq + g * g
+    sigma = (jnp.power(sq_new, -power) - jnp.power(sq, -power)) / lr
+    lin_new = lin + g - sigma * p
+    x = l1 * jnp.sign(lin_new) - lin_new
+    y = jnp.power(sq_new, -power) / lr + 2 * l2
+    p_new = jnp.where(jnp.abs(lin_new) > l1, x / y, jnp.zeros_like(p))
+    _upd(ctx, op, "SquaredAccumulator", "SquaredAccumOut", sq_new)
+    _upd(ctx, op, "LinearAccumulator", "LinearAccumOut", lin_new)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+
+
+@register("proximal_gd")
+def _proximal_gd(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    lr = ctx.in1(op, "LearningRate")
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    prox = p - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(
+        jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+
+
+@register("proximal_adagrad")
+def _proximal_adagrad(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    m = ctx.in1(op, "Moment")
+    lr = ctx.in1(op, "LearningRate")
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    m_new = m + g * g
+    lr_t = lr / jnp.sqrt(m_new)
+    prox = p - lr_t * g
+    p_new = jnp.sign(prox) * jnp.maximum(
+        jnp.abs(prox) - lr_t * l1, 0.0) / (1.0 + lr_t * l2)
+    _upd(ctx, op, "Moment", "MomentOut", m_new)
+    _upd(ctx, op, "Param", "ParamOut", p_new)
+
+
+@register("average_accumulates")
+def _average_accumulates(ctx, op):
+    """ModelAverage support (operators/average_accumulates_op.cc) —
+    maintains windowed sums of parameter values."""
+    p = ctx.in1(op, "param")
+    sum1 = ctx.in1(op, "in_sum_1")
+    sum2 = ctx.in1(op, "in_sum_2")
+    sum3 = ctx.in1(op, "in_sum_3")
+    num_acc = ctx.in1(op, "in_num_accumulates")
+    old_num = ctx.in1(op, "in_old_num_accumulates")
+    num_upd = ctx.in1(op, "in_num_updates")
+    avg_window = op.attr("average_window", 0.0)
+    max_avg_win = op.attr("max_average_window", 10000)
+    min_avg_win = op.attr("min_average_window", 10000)
+
+    # reference semantics (average_accumulates_op.h): accumulate param into
+    # sum1 each step; when the window is reached, fold everything into sum3
+    # and reset sum1/sum2 — apply() divides (s1+s2+s3)/(num+old_num).
+    num_upd_n = num_upd + 1
+    num_acc_n = num_acc + 1
+    sum1_acc = sum1 + p
+    window = jnp.minimum(
+        jnp.maximum(min_avg_win, avg_window * num_upd_n.astype(jnp.float32)),
+        max_avg_win).astype(jnp.float32)
+    roll = num_acc_n.astype(jnp.float32) >= window
+    sum1_n = jnp.where(roll, jnp.zeros_like(sum1), sum1_acc)
+    sum2_n = jnp.where(roll, jnp.zeros_like(sum2), sum2)
+    sum3_n = jnp.where(roll, sum1_acc + sum2, sum3)
+    old_num_n = jnp.where(roll, num_acc_n, old_num)
+    num_acc_n = jnp.where(roll, jnp.zeros_like(num_acc_n), num_acc_n)
+
+    _upd(ctx, op, "in_sum_1", "out_sum_1", sum1_n)
+    _upd(ctx, op, "in_sum_2", "out_sum_2", sum2_n)
+    _upd(ctx, op, "in_sum_3", "out_sum_3", sum3_n)
+    _upd(ctx, op, "in_num_accumulates", "out_num_accumulates", num_acc_n)
+    _upd(ctx, op, "in_old_num_accumulates", "out_old_num_accumulates",
+         old_num_n)
+    _upd(ctx, op, "in_num_updates", "out_num_updates", num_upd_n)

@@ -1,0 +1,191 @@
+"""Reader-decorator combinators + minibatching.
+
+Reference parity: python/paddle/reader/decorator.py:29-236 (map_readers,
+shuffle, chain, compose, buffered, firstn, xmap_readers) and
+python/paddle/v2/minibatch.py (batch). A reader is a zero-arg callable
+returning an iterator of samples.
+"""
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "batch", "cache",
+           "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(x) for x in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(x) for x in outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples on a background thread (the host half of
+    the reference's double_buffer reader op)."""
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+
+        def feed():
+            try:
+                for d in r:
+                    q.put(d)
+                q.put(_End)
+            except BaseException as exc:   # propagate to the consumer
+                q.put(exc)
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            if isinstance(e, BaseException):
+                raise e
+            yield e
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (decorator.py:236)."""
+    end = object()
+
+    def data_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def read_worker():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample) if order else sample)
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def map_worker():
+            while True:
+                sample = in_q.get()
+                if sample is end:
+                    out_q.put(end)
+                    return
+                if order:
+                    i, s = sample
+                    out_q.put((i, mapper(s)))
+                else:
+                    out_q.put(mapper(sample))
+
+        threading.Thread(target=read_worker, daemon=True).start()
+        workers = [threading.Thread(target=map_worker, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, s = item
+                pending[i] = s
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item
+    return data_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def data_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader

@@ -1,0 +1,39 @@
+"""Test config: force an 8-virtual-device CPU platform BEFORE jax import so
+multi-chip sharding tests run without TPU hardware (SURVEY.md §7 strategy;
+the driver's dryrun_multichip uses the same mechanism)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test fresh default programs + scope + name generator."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.core import unique_name
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    old_main = fluid.switch_main_program(main)
+    old_startup = fluid.switch_startup_program(startup)
+    old_scope = scope_mod._global_scope
+    scope_mod._global_scope = scope_mod.Scope()
+    with unique_name.guard():
+        yield
+    fluid.switch_main_program(old_main)
+    fluid.switch_startup_program(old_startup)
+    scope_mod._global_scope = old_scope
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,41 @@
+"""Book test: recognize_digits (reference
+python/paddle/fluid/tests/book/test_recognize_digits.py) — train an MNIST MLP
+until the loss crosses a threshold. This is the M1 acceptance test."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu as fluid
+
+
+def test_recognize_digits_mlp():
+    img = fluid.layers.data("img", [784])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    hidden = fluid.layers.fc(img, 128, act="relu")
+    hidden = fluid.layers.fc(hidden, 64, act="relu")
+    prediction = fluid.layers.fc(hidden, 10, act="softmax")
+    cost = fluid.layers.cross_entropy(prediction, label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(prediction, label)
+    fluid.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.mnist.train(2048), 500),
+        batch_size=64)
+    feeder = fluid.DataFeeder([img, label], fluid.CPUPlace())
+
+    first_loss = last_loss = last_acc = None
+    for epoch in range(4):
+        for batch in train_reader():
+            feed = feeder.feed(batch)
+            feed["label"] = feed["label"].reshape(-1, 1)
+            loss_v, acc_v = exe.run(feed=feed, fetch_list=[avg_cost, acc])
+            if first_loss is None:
+                first_loss = float(loss_v)
+            last_loss = float(loss_v)
+            last_acc = float(acc_v)
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+    assert last_acc > 0.8, last_acc

@@ -1,0 +1,87 @@
+"""Core IR tests: Program/Block/Operator/Variable construction, clone,
+prune, serialization (reference analog: framework.py unit tests)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_program_build():
+    prog = fluid.default_main_program()
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.fc(x, 3)
+    assert y.shape == (-1, 3)
+    ops = [op.type for op in prog.global_block().ops]
+    assert "mul" in ops and "elementwise_add" in ops
+    params = prog.global_block().all_parameters()
+    assert len(params) == 2  # weight + bias
+    w = [p for p in params if p.shape == (4, 3)]
+    assert len(w) == 1
+
+
+def test_program_clone_and_serialize():
+    prog = fluid.default_main_program()
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.fc(x, 3, act="relu")
+    clone = prog.clone()
+    assert len(clone.global_block().ops) == len(prog.global_block().ops)
+    # mutating the clone must not touch the original
+    clone.global_block().append_op(type="mean", inputs={"X": [y.name]},
+                                   outputs={"Out": ["m"]})
+    assert len(clone.global_block().ops) == \
+        len(prog.global_block().ops) + 1
+
+    js = prog.to_json()
+    rt = fluid.Program.from_json(js)
+    assert [op.type for op in rt.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+    assert set(rt.global_block().vars) == set(prog.global_block().vars)
+    # parameters survive round-trip as parameters
+    assert len(rt.global_block().all_parameters()) == 2
+
+
+def test_clone_for_test_flips_dropout():
+    prog = fluid.default_main_program()
+    x = fluid.layers.data("x", [4])
+    d = fluid.layers.dropout(x, 0.5)
+    t = prog.clone(for_test=True)
+    dropout_ops = [op for op in t.global_block().ops
+                   if op.type == "dropout"]
+    assert dropout_ops[0].attr("is_test") is True
+    # original untouched
+    assert not [op for op in prog.global_block().ops
+                if op.type == "dropout"][0].attr("is_test", False)
+
+
+def test_prune():
+    prog = fluid.default_main_program()
+    x = fluid.layers.data("x", [4])
+    a = fluid.layers.fc(x, 3)
+    b = fluid.layers.fc(x, 5)   # not needed for target a
+    pruned = prog.prune([a])
+    kept_ops = pruned.global_block().ops
+    assert len(kept_ops) < len(prog.global_block().ops)
+    out_names = {n for op in kept_ops for n in op.output_names}
+    assert a.name in out_names
+    assert b.name not in out_names
+
+
+def test_variable_sugar_builds_ops():
+    prog = fluid.default_main_program()
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.data("y", [4])
+    z = x + y
+    w = z * 2.0
+    ops = [op.type for op in prog.global_block().ops]
+    assert "elementwise_add" in ops
+    assert "scale" in ops
+
+
+def test_scope():
+    s = fluid.Scope()
+    s.set("a", np.ones(3))
+    kid = s.new_scope()
+    assert kid.has_var("a")
+    kid.set("b", np.zeros(2))
+    assert not s.has_var("b")
+    assert np.allclose(kid.find_var("a"), 1.0)
