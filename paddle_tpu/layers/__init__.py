@@ -1,6 +1,10 @@
 """fluid.layers-compatible DSL surface."""
 
 from . import ops  # noqa: F401
+from .conv_layers import (  # noqa: F401
+    conv2d, conv2d_transpose, conv3d, conv3d_transpose, pool2d, pool3d,
+    roi_pool, row_conv, spp,
+)
 from .io_ops import data  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .nn import (  # noqa: F401

@@ -1,0 +1,6 @@
+"""Model zoo: Program-building functions for the reference's benchmark
+models (benchmark/fluid/{mnist,resnet,vgg,machine_translation,
+stacked_dynamic_lstm}.py + tests/unittests/transformer_model.py), built
+TPU-first with the paddle_tpu layers DSL."""
+
+from . import mlp, resnet, vgg  # noqa: F401

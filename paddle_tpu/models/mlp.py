@@ -1,0 +1,30 @@
+"""MNIST MLP + conv models (reference benchmark/fluid/mnist.py cnn_model)."""
+
+import paddle_tpu as fluid
+
+
+def mlp(img, label, hidden_sizes=(128, 64), num_classes=10):
+    x = img
+    for h in hidden_sizes:
+        x = fluid.layers.fc(x, h, act="relu")
+    prediction = fluid.layers.fc(x, num_classes, act="softmax")
+    cost = fluid.layers.cross_entropy(prediction, label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc
+
+
+def cnn(img, label, num_classes=10):
+    """LeNet-style conv net (mnist.py cnn_model: two conv-pool blocks +
+    fc softmax head)."""
+    conv1 = fluid.layers.conv2d(img, num_filters=20, filter_size=5,
+                                act="relu")
+    pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = fluid.layers.conv2d(pool1, num_filters=50, filter_size=5,
+                                act="relu")
+    pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    prediction = fluid.layers.fc(pool2, num_classes, act="softmax")
+    cost = fluid.layers.cross_entropy(prediction, label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc

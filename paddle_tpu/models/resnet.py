@@ -1,0 +1,91 @@
+"""ResNet for CIFAR-10 and ImageNet (reference benchmark/fluid/resnet.py
+capabilities, re-built with the TPU-first layers).
+
+The north-star perf model (SURVEY.md §6): ResNet-50 images/sec/chip.
+"""
+
+import paddle_tpu as fluid
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+    conv = fluid.layers.conv2d(input, num_filters=ch_out,
+                               filter_size=filter_size, stride=stride,
+                               padding=padding, act=None, bias_attr=False)
+    return fluid.layers.batch_norm(conv, act=act)
+
+
+def shortcut(input, ch_out, stride):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None)
+    return input
+
+
+def basicblock(input, ch_out, stride):
+    short = shortcut(input, ch_out, stride)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
+    return fluid.layers.elementwise_add(short, conv2, act="relu")
+
+
+def bottleneck(input, ch_out, stride):
+    short = shortcut(input, ch_out * 4, stride)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
+    return fluid.layers.elementwise_add(short, conv3, act="relu")
+
+
+def layer_warp(block_func, input, ch_out, count, stride):
+    res = block_func(input, ch_out, stride)
+    for _ in range(1, count):
+        res = block_func(res, ch_out, 1)
+    return res
+
+
+def resnet_cifar10(input, depth=32, num_classes=10):
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, ch_out=16, filter_size=3, stride=1,
+                          padding=1)
+    res1 = layer_warp(basicblock, conv1, 16, n, 1)
+    res2 = layer_warp(basicblock, res1, 32, n, 2)
+    res3 = layer_warp(basicblock, res2, 64, n, 2)
+    pool = fluid.layers.pool2d(res3, pool_type="avg", global_pooling=True)
+    return fluid.layers.fc(pool, num_classes, act="softmax")
+
+
+def resnet_imagenet(input, depth=50, num_classes=1000):
+    cfg = {18: ([2, 2, 2, 1], basicblock),
+           34: ([3, 4, 6, 3], basicblock),
+           50: ([3, 4, 6, 3], bottleneck),
+           101: ([3, 4, 23, 3], bottleneck),
+           152: ([3, 8, 36, 3], bottleneck)}
+    stages, block_func = cfg[depth]
+    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                          padding=3)
+    pool1 = fluid.layers.pool2d(conv1, pool_size=3, pool_stride=2,
+                                pool_padding=1)
+    res1 = layer_warp(block_func, pool1, 64, stages[0], 1)
+    res2 = layer_warp(block_func, res1, 128, stages[1], 2)
+    res3 = layer_warp(block_func, res2, 256, stages[2], 2)
+    res4 = layer_warp(block_func, res3, 512, stages[3], 2)
+    pool2 = fluid.layers.pool2d(res4, pool_type="avg", global_pooling=True)
+    return fluid.layers.fc(pool2, num_classes, act="softmax")
+
+
+def build_train_net(model="resnet_cifar10", depth=None, image_shape=(3, 32, 32),
+                    num_classes=10, learning_rate=0.01):
+    """Returns (image, label, avg_cost, accuracy)."""
+    image = fluid.layers.data("data", list(image_shape))
+    label = fluid.layers.data("label", [1], dtype="int64")
+    if model == "resnet_cifar10":
+        predict = resnet_cifar10(image, depth or 32, num_classes)
+    else:
+        predict = resnet_imagenet(image, depth or 50, num_classes)
+    cost = fluid.layers.cross_entropy(predict, label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(predict, label)
+    fluid.optimizer.Momentum(learning_rate=learning_rate,
+                             momentum=0.9).minimize(avg_cost)
+    return image, label, avg_cost, acc

@@ -1,0 +1,53 @@
+"""VGG-16 (reference benchmark/fluid/vgg.py capabilities, TPU-first)."""
+
+import paddle_tpu as fluid
+
+
+def img_conv_group(input, conv_num_filter, conv_filter_size=3, pool_size=2,
+                   pool_stride=2, conv_act="relu", conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=None, pool_type="max"):
+    """Composite conv group (reference python/paddle/fluid/nets.py
+    img_conv_group)."""
+    tmp = input
+    drop_rates = conv_batchnorm_drop_rate or [0.0] * len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        tmp = fluid.layers.conv2d(
+            tmp, num_filters=nf, filter_size=conv_filter_size, padding=1,
+            act=None if conv_with_batchnorm else conv_act)
+        if conv_with_batchnorm:
+            tmp = fluid.layers.batch_norm(tmp, act=conv_act)
+            if drop_rates[i] > 0:
+                tmp = fluid.layers.dropout(tmp, dropout_prob=drop_rates[i])
+    return fluid.layers.pool2d(tmp, pool_size=pool_size,
+                               pool_stride=pool_stride, pool_type=pool_type)
+
+
+def vgg16_bn_drop(input, num_classes=10):
+    def group(x, num, filters):
+        return img_conv_group(x, conv_num_filter=[filters] * num,
+                              conv_with_batchnorm=True,
+                              conv_batchnorm_drop_rate=[0.3] * (num - 1) + [0.0])
+
+    conv1 = group(input, 2, 64)
+    conv2 = group(conv1, 2, 128)
+    conv3 = group(conv2, 3, 256)
+    conv4 = group(conv3, 3, 512)
+    conv5 = group(conv4, 3, 512)
+    drop = fluid.layers.dropout(conv5, dropout_prob=0.5)
+    fc1 = fluid.layers.fc(drop, 512, act=None)
+    bn = fluid.layers.batch_norm(fc1, act="relu")
+    drop2 = fluid.layers.dropout(bn, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(drop2, 512, act=None)
+    return fluid.layers.fc(fc2, num_classes, act="softmax")
+
+
+def build_train_net(image_shape=(3, 32, 32), num_classes=10,
+                    learning_rate=1e-3):
+    image = fluid.layers.data("data", list(image_shape))
+    label = fluid.layers.data("label", [1], dtype="int64")
+    predict = vgg16_bn_drop(image, num_classes)
+    cost = fluid.layers.cross_entropy(predict, label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(predict, label)
+    fluid.optimizer.Adam(learning_rate=learning_rate).minimize(avg_cost)
+    return image, label, avg_cost, acc

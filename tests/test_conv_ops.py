@@ -1,0 +1,249 @@
+"""Conv/pool/vision op tests vs torch-CPU references (the OpTest pattern:
+numpy/torch expected outputs, SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import paddle_tpu as fluid
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2),
+])
+def test_conv2d_matches_torch(rng, stride, padding, dilation, groups):
+    x = rng.rand(2, 4, 9, 9).astype(np.float32)
+    w = rng.rand(6, 4 // groups, 3, 3).astype(np.float32)
+
+    xv = fluid.layers.data("x", [4, 9, 9])
+    wv = fluid.layers.data("w", [6, 4 // groups, 3, 3],
+                           append_batch_size=False)
+    out = fluid.default_main_program().current_block().create_var(
+        name="conv_out", dtype="float32")
+    fluid.default_main_program().current_block().append_op(
+        type="conv2d", inputs={"Input": [xv], "Filter": [wv]},
+        outputs={"Output": [out]},
+        attrs={"strides": [stride] * 2, "paddings": [padding] * 2,
+               "dilations": [dilation] * 2, "groups": groups})
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"x": x, "w": w}, fetch_list=[out])
+    want = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), None,
+                    stride, padding, dilation, groups).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("stride,padding", [(1, 0), (2, 1)])
+def test_conv2d_transpose_matches_torch(rng, stride, padding):
+    x = rng.rand(2, 4, 7, 7).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)  # [Cin, Cout, kh, kw]
+    xv = fluid.layers.data("x", [4, 7, 7])
+    wv = fluid.layers.data("w", [4, 3, 3, 3], append_batch_size=False)
+    out = fluid.default_main_program().current_block().create_var(
+        name="convt_out", dtype="float32")
+    fluid.default_main_program().current_block().append_op(
+        type="conv2d_transpose", inputs={"Input": [xv], "Filter": [wv]},
+        outputs={"Output": [out]},
+        attrs={"strides": [stride] * 2, "paddings": [padding] * 2,
+               "dilations": [1, 1], "groups": 1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"x": x, "w": w}, fetch_list=[out])
+    want = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                              None, stride, padding).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+@pytest.mark.parametrize("ksize,stride,padding,ceil_mode", [
+    (2, 2, 0, False), (3, 2, 1, False), (3, 2, 1, True),
+])
+def test_pool2d_matches_torch(rng, ptype, ksize, stride, padding, ceil_mode):
+    x = rng.rand(2, 3, 9, 9).astype(np.float32)
+    xv = fluid.layers.data("x", [3, 9, 9])
+    out = fluid.layers.pool2d(xv, pool_size=ksize, pool_type=ptype,
+                              pool_stride=stride, pool_padding=padding,
+                              ceil_mode=ceil_mode, exclusive=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"x": x}, fetch_list=[out])
+    t = torch.from_numpy(x)
+    if ptype == "max":
+        want = F.max_pool2d(t, ksize, stride, padding,
+                            ceil_mode=ceil_mode).numpy()
+    else:
+        want = F.avg_pool2d(t, ksize, stride, padding, ceil_mode=ceil_mode,
+                            count_include_pad=True).numpy()
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_global_and_adaptive_pool(rng):
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    xv = fluid.layers.data("x", [3, 8, 8])
+    out = fluid.layers.pool2d(xv, pool_type="avg", global_pooling=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(got.reshape(2, 3), x.mean((2, 3)), rtol=1e-5)
+
+
+def test_max_pool_with_index_and_unpool(rng):
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    xv = fluid.layers.data("x", [2, 6, 6])
+    blk = fluid.default_main_program().current_block()
+    out = blk.create_var(name="p_out", dtype="float32")
+    mask = blk.create_var(name="p_mask", dtype="int32")
+    blk.append_op(type="max_pool2d_with_index",
+                  inputs={"X": [xv]}, outputs={"Out": [out], "Mask": [mask]},
+                  attrs={"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [0, 0]})
+    un = blk.create_var(name="unpool_out", dtype="float32")
+    blk.append_op(type="unpool", inputs={"X": [out], "Indices": [mask]},
+                  outputs={"Out": [un]},
+                  attrs={"ksize": [2, 2], "strides": [2, 2]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, gmask, gun = exe.run(feed={"x": x}, fetch_list=[out, mask, un])
+    tout, tidx = F.max_pool2d(torch.from_numpy(x), 2, 2,
+                              return_indices=True)
+    np.testing.assert_allclose(got, tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(gmask, tidx.numpy())
+    tun = F.max_unpool2d(tout, tidx, 2, 2).numpy()
+    np.testing.assert_allclose(gun, tun, rtol=1e-6)
+
+
+def test_max_pool_with_index_negative_input_and_padding(rng):
+    # regression: pad cells must never win the max (pad with -inf, not 0)
+    x = -1.0 - rng.rand(1, 1, 4, 4).astype(np.float32)
+    xv = fluid.layers.data("x", [1, 4, 4])
+    blk = fluid.default_main_program().current_block()
+    out = blk.create_var(name="p_out", dtype="float32")
+    mask = blk.create_var(name="p_mask", dtype="int32")
+    blk.append_op(type="max_pool2d_with_index",
+                  inputs={"X": [xv]}, outputs={"Out": [out], "Mask": [mask]},
+                  attrs={"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [1, 1]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, gmask = exe.run(feed={"x": x}, fetch_list=[out, mask])
+    tout, tidx = F.max_pool2d(torch.from_numpy(x), 2, 2, 1,
+                              return_indices=True)
+    np.testing.assert_allclose(got, tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(gmask, tidx.numpy())
+
+
+def test_unpool_with_padding(rng):
+    # 6x6, k=2, s=2, p=1 round-trips exactly through the reference's
+    # unpool size formula (in-1)*s - 2p + k
+    x = rng.rand(1, 1, 6, 6).astype(np.float32)
+    xv = fluid.layers.data("x", [1, 6, 6])
+    blk = fluid.default_main_program().current_block()
+    out = blk.create_var(name="p_out", dtype="float32")
+    mask = blk.create_var(name="p_mask", dtype="int32")
+    blk.append_op(type="max_pool2d_with_index",
+                  inputs={"X": [xv]}, outputs={"Out": [out], "Mask": [mask]},
+                  attrs={"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [1, 1]})
+    un = blk.create_var(name="unpool_out", dtype="float32")
+    blk.append_op(type="unpool", inputs={"X": [out], "Indices": [mask]},
+                  outputs={"Out": [un]},
+                  attrs={"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [1, 1]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    gun, = exe.run(feed={"x": x}, fetch_list=[un])
+    tout, tidx = F.max_pool2d(torch.from_numpy(x), 2, 2, 1,
+                              return_indices=True)
+    tun = F.max_unpool2d(tout, tidx, 2, 2, 1, output_size=(6, 6)).numpy()
+    np.testing.assert_allclose(gun, tun, rtol=1e-6)
+
+
+def test_adaptive_pool_non_divisible(rng):
+    x = rng.rand(1, 2, 10, 10).astype(np.float32)
+    xv = fluid.layers.data("x", [2, 10, 10])
+    blk = fluid.default_main_program().current_block()
+    outs = {}
+    for ptype in ("max", "avg"):
+        o = blk.create_var(name="ap_%s" % ptype, dtype="float32")
+        blk.append_op(type="pool2d", inputs={"X": [xv]},
+                      outputs={"Out": [o]},
+                      attrs={"ksize": [4, 4], "pooling_type": ptype,
+                             "adaptive": True})
+        outs[ptype] = o
+    exe = fluid.Executor(fluid.CPUPlace())
+    gmax, gavg = exe.run(feed={"x": x}, fetch_list=[outs["max"],
+                                                    outs["avg"]])
+    t = torch.from_numpy(x)
+    np.testing.assert_allclose(gmax, F.adaptive_max_pool2d(t, 4).numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(gavg, F.adaptive_avg_pool2d(t, 4).numpy(),
+                               rtol=1e-6)
+
+
+def test_conv_transpose_output_size_enlarge(rng):
+    x = rng.rand(1, 4, 7, 7).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    xv = fluid.layers.data("x", [4, 7, 7])
+    wv = fluid.layers.data("w", [4, 3, 3, 3], append_batch_size=False)
+    blk = fluid.default_main_program().current_block()
+    out = blk.create_var(name="convt_out", dtype="float32")
+    blk.append_op(
+        type="conv2d_transpose", inputs={"Input": [xv], "Filter": [wv]},
+        outputs={"Output": [out]},
+        attrs={"strides": [2, 2], "paddings": [0, 0], "dilations": [1, 1],
+               "groups": 1, "output_size": [16, 16]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"x": x, "w": w}, fetch_list=[out])
+    want = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                              None, 2, 0, output_padding=1).numpy()
+    assert got.shape == (1, 3, 16, 16)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_conv2d_layer_trains(rng):
+    img = fluid.layers.data("img", [1, 8, 8])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                               padding=1, act="relu")
+    pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+    pred = fluid.layers.fc(pool, 10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = rng.rand(16, 1, 8, 8).astype(np.float32)
+    y = rng.randint(0, 10, (16, 1)).astype(np.int64)
+    losses = []
+    for _ in range(12):
+        lv, = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0]
+
+
+def test_depthwise_conv(rng):
+    x = rng.rand(2, 4, 8, 8).astype(np.float32)
+    w = rng.rand(4, 1, 3, 3).astype(np.float32)
+    xv = fluid.layers.data("x", [4, 8, 8])
+    wv = fluid.layers.data("w", [4, 1, 3, 3], append_batch_size=False)
+    blk = fluid.default_main_program().current_block()
+    out = blk.create_var(name="dw_out", dtype="float32")
+    blk.append_op(type="depthwise_conv2d",
+                  inputs={"Input": [xv], "Filter": [wv]},
+                  outputs={"Output": [out]},
+                  attrs={"strides": [1, 1], "paddings": [1, 1],
+                         "dilations": [1, 1]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"x": x, "w": w}, fetch_list=[out])
+    want = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), None,
+                    1, 1, 1, groups=4).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_roi_pool(rng):
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 3, 3], [4, 4, 7, 7]], np.float32)
+    xv = fluid.layers.data("x", [1, 8, 8])
+    rv = fluid.layers.data("rois", [2, 4], append_batch_size=False)
+    out = fluid.layers.roi_pool(xv, rv, pooled_height=2, pooled_width=2,
+                                spatial_scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"x": x, "rois": rois}, fetch_list=[out])
+    assert got.shape == (2, 1, 2, 2)
+    # roi 0 covers rows/cols 0..3: max of each 2x2 quadrant
+    np.testing.assert_allclose(got[0, 0], [[9., 11.], [25., 27.]])

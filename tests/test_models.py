@@ -1,0 +1,64 @@
+"""Model-zoo smoke tests: the "book"-test pattern (SURVEY.md §4.3) —
+train a few steps, assert loss decreases / shapes hold."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet, vgg, mlp
+
+
+def _train_steps(image, label, avg_cost, batch=8, shape=(3, 16, 16),
+                 classes=10, steps=6, rng=None):
+    rng = rng or np.random.RandomState(0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = rng.rand(batch, *shape).astype(np.float32)
+    y = rng.randint(0, classes, (batch, 1)).astype(np.int64)
+    losses = []
+    for _ in range(steps):
+        lv, = exe.run(feed={"data": x, "label": y}, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv)))
+    return losses
+
+
+def test_resnet_cifar10_trains():
+    image, label, avg_cost, acc = resnet.build_train_net(
+        model="resnet_cifar10", depth=8, image_shape=(3, 16, 16),
+        learning_rate=0.05)
+    losses = _train_steps(image, label, avg_cost)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_resnet50_imagenet_builds_and_runs():
+    image, label, avg_cost, acc = resnet.build_train_net(
+        model="resnet_imagenet", depth=50, image_shape=(3, 64, 64),
+        num_classes=100)
+    losses = _train_steps(image, label, avg_cost, batch=2,
+                          shape=(3, 64, 64), classes=100, steps=2)
+    assert np.isfinite(losses).all()
+
+
+def test_vgg16_trains():
+    image, label, avg_cost, acc = vgg.build_train_net(
+        image_shape=(3, 32, 32), learning_rate=1e-3)
+    losses = _train_steps(image, label, avg_cost, batch=4,
+                          shape=(3, 32, 32), steps=3)
+    assert np.isfinite(losses).all()
+
+
+def test_mnist_cnn_trains():
+    img = fluid.layers.data("img", [1, 28, 28])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    _, avg_cost, acc = mlp.cnn(img, label)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    x = rng.rand(8, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, (8, 1)).astype(np.int64)
+    losses = []
+    for _ in range(8):
+        lv, = exe.run(feed={"img": x, "label": y}, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0]
