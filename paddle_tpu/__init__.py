@@ -36,6 +36,13 @@ from .data_feeder import DataFeeder  # noqa: F401
 from . import dataset  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import batch  # noqa: F401
+from . import io  # noqa: F401
+from . import nets  # noqa: F401
+from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
+from .transpiler import (  # noqa: F401
+    InferenceTranspiler, memory_optimize, release_memory,
+)
 
 __version__ = "0.1.0"
 

@@ -239,6 +239,35 @@ def _lower_op(ctx, op):
             "no TPU lowering registered for op %r (registered: %d ops)"
             % (op.type, len(registry.registered_ops())))
     info.lower(ctx, op)
+    _propagate_lod(ctx, op)
+
+
+def _propagate_lod(ctx, op):
+    """LoD (sequence lengths) flow through row-preserving ops.
+
+    The reference's ops copy LoD from input to output inside each kernel
+    (ShareLoD in InferShape). Here: if a lowering didn't set ``out@LOD``
+    itself (sequence_* ops do), any output with the same leading dim as an
+    LoD-carrying input inherits that input's lengths. This is what lets
+    ``embedding → sequence_pool`` see per-sequence boundaries."""
+    in_lod = None
+    lead = None
+    for name in op.input_names:
+        lod = ctx.env.get(name + "@LOD")
+        if lod is not None:
+            val = ctx.env.get(name)
+            if val is not None and getattr(val, "ndim", 0) >= 1:
+                in_lod, lead = lod, val.shape[0]
+                break
+    if in_lod is None:
+        return
+    for name in op.output_names:
+        if name + "@LOD" in ctx.env:
+            continue  # lowering set it explicitly
+        val = ctx.env.get(name)
+        if val is not None and getattr(val, "ndim", 0) >= 1 \
+                and val.shape[0] == lead:
+            ctx.env[name + "@LOD"] = in_lod
 
 
 def _lower_feed_fetch(ctx, op):

@@ -140,6 +140,7 @@ class Variable:
     def __truediv__(self, o):  return self._elementwise(o, "elementwise_div")
     def __rtruediv__(self, o): return self._elementwise(o, "elementwise_div", True)
     def __pow__(self, o):  return self._elementwise(o, "elementwise_pow")
+    def __rpow__(self, o): return self._elementwise(o, "elementwise_pow", True)
     def __neg__(self):
         from ..layers import math_ops
         return math_ops.scale_var(self, -1.0)

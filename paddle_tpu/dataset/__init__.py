@@ -1,4 +1,7 @@
 """Built-in datasets (synthetic, egress-free) — parity with
 python/paddle/dataset/ (15 datasets; see each module)."""
 
-from . import common, mnist  # noqa: F401
+from . import (  # noqa: F401
+    cifar, common, conll05, flowers, image, imdb, imikolov, mnist,
+    movielens, mq2007, sentiment, uci_housing, voc2012, wmt14, wmt16,
+)

@@ -16,7 +16,9 @@ NUM_CLASSES = 10
 
 def _synthetic(n, seed):
     rng = common.synthetic_rng("mnist", seed)
-    centers = rng.randn(NUM_CLASSES, IMAGE_DIM).astype(np.float32) * 0.8
+    # split-independent centers: train and test share the class structure
+    centers = common.synthetic_rng("mnist_centers", 0).randn(
+        NUM_CLASSES, IMAGE_DIM).astype(np.float32) * 0.8
     labels = rng.randint(0, NUM_CLASSES, size=n)
     imgs = centers[labels] + 0.3 * rng.randn(n, IMAGE_DIM).astype(np.float32)
     imgs = np.clip(imgs, -1.0, 1.0).astype(np.float32)

@@ -1,0 +1,249 @@
+"""Model / parameter persistence.
+
+Reference parity: python/paddle/fluid/io.py:66-418 (save/load_vars, params,
+persistables, inference model) and the save/load ops (operators/save_op.cc,
+load_op.cc, save_combine_op.cc, load_combine_op.cc).
+
+TPU-first: persistable state lives in a Scope as host-transferable jax
+arrays, so persistence is host-side numpy serialization — there is no need
+for in-graph save/load kernels (the reference needed them because variables
+lived on the C++ side). Formats: one ``.npy`` per var, or a single ``.npz``
+for the *_combine variants. Inference model = pruned Program JSON
+(``__model__``) + params, mirroring io.py:298-418.
+
+Checkpointing follows the Go-pserver pattern (go/pserver/service.go:346):
+write to a temp file, fsync, then atomically rename, with a CRC + meta JSON
+so a torn write can never be mistaken for a checkpoint.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import zlib
+
+import numpy as np
+
+from .core.program import Program, Parameter, default_main_program
+from .core.scope import global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+    "save_checkpoint", "load_checkpoint",
+]
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def _collect(main_program, predicate, vars=None):
+    main_program = main_program or default_main_program()
+    if vars is not None:
+        out = []
+        for v in vars:
+            out.append(main_program.global_block().var(v)
+                       if isinstance(v, str) else v)
+        return out
+    return [v for v in main_program.list_vars() if predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """Save scope values of selected vars under `dirname`
+    (io.py:66 save_vars)."""
+    scope = scope or global_scope()
+    varlist = _collect(main_program, predicate or _is_persistable, vars)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        arrays = {}
+        for v in varlist:
+            val = scope.find_var(v.name)
+            if val is None:
+                raise ValueError("var %r has no value in scope" % v.name)
+            arrays[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **arrays)
+        return
+    for v in varlist:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise ValueError("var %r has no value in scope" % v.name)
+        np.save(os.path.join(dirname, v.name + ".npy"), np.asarray(val))
+
+
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename, scope=scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename,
+                     scope=scope)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """Load saved arrays into the scope (io.py:132 load_vars)."""
+    scope = scope or global_scope()
+    varlist = _collect(main_program, predicate or _is_persistable, vars)
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not path.endswith(".npz"):
+            path += ".npz"
+        arrays = np.load(path)
+        for v in varlist:
+            if v.name in arrays:
+                scope.set(v.name, arrays[v.name])
+        return
+    for v in varlist:
+        path = os.path.join(dirname, v.name + ".npy")
+        if os.path.exists(path):
+            scope.set(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename,
+                     scope=scope)
+
+
+# --------------------------------------------------------------------------
+# inference model (io.py:298-418)
+# --------------------------------------------------------------------------
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    pruned = main_program.prune(target_vars)
+    return pruned.clone(for_test=True)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename="__model__",
+                         params_filename=None, scope=None):
+    main_program = main_program or default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+
+    inference_program = get_inference_program(target_vars, main_program)
+    d = inference_program.to_dict()
+    d["feed_names"] = list(feeded_var_names)
+    d["fetch_names"] = [v.name if not isinstance(v, str) else v
+                        for v in target_vars]
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(d, f)
+    save_persistables(executor, dirname, inference_program,
+                      filename=params_filename, scope=scope)
+    return d["fetch_names"]
+
+
+def load_inference_model(dirname, executor, model_filename="__model__",
+                         params_filename=None, scope=None):
+    """Returns (program, feed_target_names, fetch_targets)."""
+    with open(os.path.join(dirname, model_filename)) as f:
+        d = json.load(f)
+    program = Program.from_dict(d)
+    load_persistables(executor, dirname, program, filename=params_filename,
+                      scope=scope)
+    fetch_targets = [program.global_block().var(n)
+                     for n in d.get("fetch_names", [])]
+    return program, d.get("feed_names", []), fetch_targets
+
+
+# --------------------------------------------------------------------------
+# atomic checkpoint (Go pserver pattern: CRC + atomic meta — service.go:346)
+# --------------------------------------------------------------------------
+
+def save_checkpoint(dirname, step, main_program=None, scope=None,
+                    keep_last=3):
+    """Atomic checkpoint: npz written to tmp + fsync + rename; meta JSON with
+    CRC32 written last, also atomically. A reader only trusts checkpoints
+    whose meta exists and whose CRC matches."""
+    scope = scope or global_scope()
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    ckpt_name = "ckpt-%d.npz" % step
+    arrays = {}
+    for v in main_program.list_vars():
+        if v.persistable:
+            val = scope.find_var(v.name)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        path = os.path.join(dirname, ckpt_name)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    with open(path, "rb") as f:
+        crc = zlib.crc32(f.read())
+    meta = {"step": step, "file": ckpt_name, "crc32": crc}
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dirname, "meta-%d.json" % step))
+
+    # prune old checkpoints
+    steps = sorted(int(n.split("-")[1].split(".")[0])
+                   for n in os.listdir(dirname) if n.startswith("meta-"))
+    for s in steps[:-keep_last]:
+        for n in ("ckpt-%d.npz" % s, "meta-%d.json" % s):
+            p = os.path.join(dirname, n)
+            if os.path.exists(p):
+                os.unlink(p)
+    return os.path.join(dirname, ckpt_name)
+
+
+def load_checkpoint(dirname, main_program=None, scope=None):
+    """Load the newest valid checkpoint; returns its step, or None if no
+    valid checkpoint exists (corrupt ones are skipped, pserver-style)."""
+    scope = scope or global_scope()
+    if not os.path.isdir(dirname):
+        return None
+    steps = sorted((int(n.split("-")[1].split(".")[0])
+                    for n in os.listdir(dirname) if n.startswith("meta-")),
+                   reverse=True)
+    for step in steps:
+        try:
+            with open(os.path.join(dirname, "meta-%d.json" % step)) as f:
+                meta = json.load(f)
+            path = os.path.join(dirname, meta["file"])
+            with open(path, "rb") as f:
+                if zlib.crc32(f.read()) != meta["crc32"]:
+                    continue
+            arrays = np.load(path)
+            for name in arrays.files:
+                scope.set(name, arrays[name])
+            return step
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            continue
+    return None

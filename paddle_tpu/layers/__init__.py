@@ -6,6 +6,11 @@ from .conv_layers import (  # noqa: F401
     roi_pool, row_conv, spp,
 )
 from .io_ops import data  # noqa: F401
+from . import learning_rate_scheduler  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    exponential_decay, inverse_time_decay, natural_exp_decay, noam_decay,
+    piecewise_decay, polynomial_decay,
+)
 from .nn import *  # noqa: F401,F403
 from .nn import (  # noqa: F401
     accuracy, auc, batch_norm, cross_entropy, dropout, embedding, fc,
@@ -14,6 +19,8 @@ from .nn import (  # noqa: F401
     square_error_cost, topk,
 )
 from .ops import *  # noqa: F401,F403
+from .math_ops import scale  # noqa: F401
+from .sequence_layers import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401
     argmax, argmin, assign, cast, concat, create_global_var, create_tensor,
     expand, fill_constant, fill_constant_batch_size_like, gather, increment,

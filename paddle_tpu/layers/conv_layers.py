@@ -22,74 +22,55 @@ def _conv_out_dim(size, k, p, s, d=1):
     return (int(size) + 2 * p - (d * (k - 1) + 1)) // s + 1
 
 
-def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
-           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
-    helper = LayerHelper("conv2d", param_attr=param_attr,
+def _conv_nd_layer(nd, op_type, input, num_filters, filter_size, stride,
+                   padding, dilation, groups, param_attr, bias_attr, act,
+                   name):
+    helper = LayerHelper(op_type, param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
     groups = groups or 1
     num_channels = input.shape[1]
-    filter_size = _pair(filter_size)
-    stride = _pair(stride)
-    padding = _pair(padding)
-    dilation = _pair(dilation)
+    filter_size = _pair(filter_size, nd)
+    stride = _pair(stride, nd)
+    padding = _pair(padding, nd)
+    dilation = _pair(dilation, nd)
     filter_shape = [num_filters, num_channels // groups] + filter_size
 
-    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    fan_in = (num_channels // groups) * int(np.prod(filter_size))
     std = (2.0 / fan_in) ** 0.5
     filter_param = helper.create_parameter(
         attr=helper.param_attr, shape=filter_shape, dtype=dtype,
-        default_initializer=lambda var, blk: Normal(0.0, std)(var, blk))
+        default_initializer=Normal(0.0, std))
 
-    out_shape = (input.shape[0], num_filters,
-                 _conv_out_dim(input.shape[2], filter_size[0], padding[0],
-                               stride[0], dilation[0]),
-                 _conv_out_dim(input.shape[3], filter_size[1], padding[1],
-                               stride[1], dilation[1]))
+    out_shape = (input.shape[0], num_filters) + tuple(
+        _conv_out_dim(input.shape[2 + i], filter_size[i], padding[i],
+                      stride[i], dilation[i]) for i in range(nd))
     pre_bias = helper.create_variable_for_type_inference(dtype,
                                                          shape=out_shape)
     helper.append_op(
-        type="conv2d",
+        type=op_type,
         inputs={"Input": [input], "Filter": [filter_param]},
         outputs={"Output": [pre_bias]},
         attrs={"strides": stride, "paddings": padding, "dilations": dilation,
                "groups": groups})
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    return _conv_nd_layer(2, "conv2d", input, num_filters, filter_size,
+                          stride, padding, dilation, groups, param_attr,
+                          bias_attr, act, name)
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
            act=None, name=None):
-    helper = LayerHelper("conv3d", param_attr=param_attr,
-                         bias_attr=bias_attr, act=act, name=name)
-    dtype = input.dtype
-    groups = groups or 1
-    num_channels = input.shape[1]
-    filter_size = _pair(filter_size, 3)
-    stride = _pair(stride, 3)
-    padding = _pair(padding, 3)
-    dilation = _pair(dilation, 3)
-    filter_shape = [num_filters, num_channels // groups] + filter_size
-    fan_in = (num_channels // groups) * int(np.prod(filter_size))
-    std = (2.0 / fan_in) ** 0.5
-    filter_param = helper.create_parameter(
-        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
-        default_initializer=lambda var, blk: Normal(0.0, std)(var, blk))
-    out_shape = (input.shape[0], num_filters) + tuple(
-        _conv_out_dim(input.shape[2 + i], filter_size[i], padding[i],
-                      stride[i], dilation[i]) for i in range(3))
-    pre_bias = helper.create_variable_for_type_inference(dtype,
-                                                         shape=out_shape)
-    helper.append_op(
-        type="conv3d",
-        inputs={"Input": [input], "Filter": [filter_param]},
-        outputs={"Output": [pre_bias]},
-        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
-               "groups": groups})
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
-    return helper.append_activation(pre_act)
+    return _conv_nd_layer(3, "conv3d", input, num_filters, filter_size,
+                          stride, padding, dilation, groups, param_attr,
+                          bias_attr, act, name)
 
 
 def _conv_transpose(nd, op_type, input, num_filters, output_size=None,
@@ -116,10 +97,13 @@ def _conv_transpose(nd, op_type, input, num_filters, output_size=None,
     filter_shape = [num_channels, num_filters // groups] + filter_size
     img_filter = helper.create_parameter(
         attr=helper.param_attr, shape=filter_shape, dtype=dtype)
-    out_sp = tuple(
-        -1 if input.shape[2 + i] in (None, -1) else
-        (input.shape[2 + i] - 1) * stride[i] - 2 * padding[i]
-        + dilation[i] * (filter_size[i] - 1) + 1 for i in range(nd))
+    if output_size is not None:
+        out_sp = tuple(_pair(output_size, nd))
+    else:
+        out_sp = tuple(
+            -1 if input.shape[2 + i] in (None, -1) else
+            (input.shape[2 + i] - 1) * stride[i] - 2 * padding[i]
+            + dilation[i] * (filter_size[i] - 1) + 1 for i in range(nd))
     pre_bias = helper.create_variable_for_type_inference(
         dtype, shape=(input.shape[0], num_filters) + out_sp)
     attrs = {"strides": stride, "paddings": padding, "dilations": dilation,

@@ -59,3 +59,19 @@ def elementwise_binary(x, other, op_type, reverse=False):
     helper.append_op(type=op_type, inputs={"X": [a], "Y": [b]},
                      outputs={"Out": [out]}, attrs={"axis": -1})
     return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    """fluid.layers.scale parity (scale_op.cc): out = x*scale + bias
+    (or (x+bias)*scale when bias_after_scale=False)."""
+    if not bias_after_scale:
+        bias = bias * scale
+    out = scale_var(x, scale, bias)
+    if act is None:
+        return out
+    helper = LayerHelper(act, name=name)
+    final = helper.create_variable_for_type_inference(out.dtype,
+                                                      shape=out.shape)
+    helper.append_op(type=act, inputs={"X": [out]}, outputs={"Out": [final]})
+    return final

@@ -1,30 +1,13 @@
 """VGG-16 (reference benchmark/fluid/vgg.py capabilities, TPU-first)."""
 
 import paddle_tpu as fluid
-
-
-def img_conv_group(input, conv_num_filter, conv_filter_size=3, pool_size=2,
-                   pool_stride=2, conv_act="relu", conv_with_batchnorm=False,
-                   conv_batchnorm_drop_rate=None, pool_type="max"):
-    """Composite conv group (reference python/paddle/fluid/nets.py
-    img_conv_group)."""
-    tmp = input
-    drop_rates = conv_batchnorm_drop_rate or [0.0] * len(conv_num_filter)
-    for i, nf in enumerate(conv_num_filter):
-        tmp = fluid.layers.conv2d(
-            tmp, num_filters=nf, filter_size=conv_filter_size, padding=1,
-            act=None if conv_with_batchnorm else conv_act)
-        if conv_with_batchnorm:
-            tmp = fluid.layers.batch_norm(tmp, act=conv_act)
-            if drop_rates[i] > 0:
-                tmp = fluid.layers.dropout(tmp, dropout_prob=drop_rates[i])
-    return fluid.layers.pool2d(tmp, pool_size=pool_size,
-                               pool_stride=pool_stride, pool_type=pool_type)
+from paddle_tpu.nets import img_conv_group
 
 
 def vgg16_bn_drop(input, num_classes=10):
     def group(x, num, filters):
         return img_conv_group(x, conv_num_filter=[filters] * num,
+                              pool_size=2, pool_stride=2, conv_act="relu",
                               conv_with_batchnorm=True,
                               conv_batchnorm_drop_rate=[0.3] * (num - 1) + [0.0])
 

@@ -10,5 +10,6 @@ from . import (  # noqa: F401
     metrics_ops,
     nn,
     optimizer_ops,
+    sequence_ops,
     tensor_ops,
 )

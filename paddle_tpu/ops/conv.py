@@ -248,14 +248,20 @@ def _extract_patches(x, ksize, strides, paddings):
     # patches: [N, C*kh*kw, Ho, Wo]
     ho, wo = patches.shape[2], patches.shape[3]
     patches = patches.reshape(n, c, kh * kw, ho, wo)
-    # index map
-    idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
-    ipatch = lax.conv_general_dilated_patches(
-        jnp.pad(idx, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
-                constant_values=-1.0),
-        filter_shape=ksize, window_strides=strides, padding=[(0, 0), (0, 0)],
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    ipatch = ipatch.reshape(1, 1, kh * kw, ho, wo)
+    # analytic index map (exact int32; a float conv would lose precision
+    # above 2**24): element (ki,kj) of the patch at output (oh,ow) sits at
+    # input position (oh*sh - ph + ki, ow*sw - pw + kj)
+    sh, sw = strides
+    oh = jnp.arange(ho)[:, None, None, None]
+    ow = jnp.arange(wo)[None, :, None, None]
+    ki = jnp.arange(kh)[None, None, :, None]
+    kj = jnp.arange(kw)[None, None, None, :]
+    iy = oh * sh - ph + ki
+    ix = ow * sw - pw + kj
+    valid = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+    flat = jnp.where(valid, iy * w + ix, -1)        # [Ho, Wo, kh, kw]
+    ipatch = jnp.transpose(flat.reshape(ho, wo, kh * kw), (2, 0, 1))
+    ipatch = ipatch[None, None].astype(jnp.int32)   # [1,1,kh*kw,Ho,Wo]
     return patches, ipatch
 
 
@@ -274,8 +280,8 @@ def _max_pool2d_with_index(ctx, op):
     amax = jnp.argmax(patches, axis=2)
     out = jnp.max(patches, axis=2)
     idx = jnp.take_along_axis(
-        jnp.broadcast_to(ipatch, patches.shape), amax[:, :, None], axis=2
-    )[:, :, 0]
+        jnp.broadcast_to(ipatch, patches.shape[:2] + ipatch.shape[2:]),
+        amax[:, :, None], axis=2)[:, :, 0]
     ctx.set_out(op, "Out", out)
     ctx.set_out(op, "Mask", idx.astype(jnp.int32))
 

@@ -25,6 +25,11 @@ def _gather_label_prob(x, label):
 def _cross_entropy(ctx, op):
     x = ctx.in1(op, "X")          # probabilities [N, C]
     label = ctx.in1(op, "Label")
+    if x.shape[0] != label.shape[0]:
+        raise ValueError(
+            "cross_entropy batch mismatch: X has %d rows, Label has %d "
+            "(a silent broadcast here would train the class prior)"
+            % (x.shape[0], label.shape[0]))
     if op.attr("soft_label", False):
         if label.ndim == x.ndim - 1:
             label = label[..., None]

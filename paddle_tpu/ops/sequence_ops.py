@@ -1,0 +1,286 @@
+"""Sequence ops over flat LoD layout: data [T_total, ...] + per-sequence
+lengths (the ``<name>@LOD`` side input the Executor derives from LoDTensor
+feeds).
+
+Reference parity: operators/sequence_{pool,conv,expand,concat,reshape,
+slice,erase}_op.cc, sequence_pad/unpad semantics, operators/math/
+sequence2batch & sequence_pooling.
+
+TPU-first: LoD offsets become segment ids; every op is a segment reduction /
+gather that XLA vectorizes — no per-sequence loops. Lengths propagate to
+outputs via ``@LOD`` entries in the env so chained sequence ops keep working.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _lengths(ctx, op, slot="X"):
+    names = op.input(slot)
+    if not names:
+        return None
+    return ctx.maybe_get(names[0] + "@LOD")
+
+
+def _segments(lengths, total):
+    ends = jnp.cumsum(lengths)
+    return jnp.searchsorted(ends, jnp.arange(total), side="right")
+
+
+def _starts(lengths):
+    return jnp.cumsum(lengths) - lengths
+
+
+def _set_out_lod(ctx, op, lengths, slot="Out"):
+    name = ctx.out_name(op, slot)
+    if name is not None and lengths is not None:
+        ctx.env[name + "@LOD"] = lengths
+
+
+@register("sequence_pool")
+def _sequence_pool(ctx, op):
+    x = ctx.in1(op, "X")                     # [T, D]
+    lengths = _lengths(ctx, op)
+    ptype = op.attr("pooltype", "AVERAGE").upper()
+    if lengths is None:
+        lengths = jnp.asarray([x.shape[0]], jnp.int32)
+    n = lengths.shape[0]
+    seg = _segments(lengths, x.shape[0])
+    if ptype == "SUM":
+        out = jax.ops.segment_sum(x, seg, num_segments=n)
+    elif ptype == "AVERAGE":
+        s = jax.ops.segment_sum(x, seg, num_segments=n)
+        out = s / jnp.maximum(lengths, 1).astype(x.dtype)[:, None]
+    elif ptype == "SQRT":
+        s = jax.ops.segment_sum(x, seg, num_segments=n)
+        out = s / jnp.sqrt(jnp.maximum(lengths, 1).astype(x.dtype))[:, None]
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(x, seg, num_segments=n)
+        maxidx = _segment_argmax(x, seg, n)
+        ctx.set_out(op, "MaxIndex", maxidx)
+    elif ptype == "MIN":
+        out = jax.ops.segment_min(x, seg, num_segments=n)
+    elif ptype == "LAST":
+        idx = jnp.cumsum(lengths) - 1
+        out = x[idx]
+    elif ptype == "FIRST":
+        out = x[_starts(lengths)]
+    else:
+        raise NotImplementedError("sequence_pool type %r" % ptype)
+    ctx.set_out(op, "Out", out)
+
+
+def _segment_argmax(x, seg, n):
+    t = x.shape[0]
+    idx = jnp.arange(t)
+    # for each segment and feature, the position of the max
+    def one_feature(col):
+        best = jax.ops.segment_max(col, seg, num_segments=n)
+        is_max = col == best[seg]
+        pos = jnp.where(is_max, idx, t)
+        return jax.ops.segment_min(pos, seg, num_segments=n)
+    if x.ndim == 1:
+        return one_feature(x)
+    return jax.vmap(one_feature, in_axes=1, out_axes=1)(x).astype(jnp.int32)
+
+
+@register("sequence_first_step")
+def _sequence_first(ctx, op):
+    op.attrs = dict(op.attrs, pooltype="FIRST")
+    _sequence_pool(ctx, op)
+
+
+@register("sequence_last_step")
+def _sequence_last(ctx, op):
+    op.attrs = dict(op.attrs, pooltype="LAST")
+    _sequence_pool(ctx, op)
+
+
+@register("sequence_concat")
+def _sequence_concat(ctx, op):
+    """Concatenate multiple LoD inputs sequence-by-sequence
+    (sequence_concat_op.cc axis=0 path)."""
+    xs = ctx.in_list(op, "X")
+    lens = [ctx.maybe_get(n + "@LOD") for n in op.input("X")]
+    if any(ln is None for ln in lens):
+        ctx.set_out(op, "Out", jnp.concatenate(xs, axis=0))
+        return
+    n = lens[0].shape[0]
+    total = sum(x.shape[0] for x in xs)
+    out_lens = sum(lens[1:], lens[0])
+    # interleave: for each sequence i, take seq i of every input in order
+    parts, seg_parts = [], []
+    for x, ln in zip(xs, lens):
+        parts.append(x)
+        seg_parts.append(_segments(ln, x.shape[0]))
+    data = jnp.concatenate(parts, axis=0)
+    seg = jnp.concatenate(seg_parts, axis=0)
+    # stable sort by segment id keeps within-input order and input order
+    # (earlier inputs come first within a segment)
+    order = jnp.argsort(seg, stable=True)
+    ctx.set_out(op, "Out", data[order])
+    _set_out_lod(ctx, op, out_lens)
+    del n, total
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, op):
+    """Expand sequences of X to match the sequence counts of Y
+    (sequence_expand_op.cc): each sequence i of X is repeated so its length
+    times Y's seq-i length."""
+    x = ctx.in1(op, "X")
+    x_lens = _lengths(ctx, op, "X")
+    y_lens = _lengths(ctx, op, "Y")
+    if y_lens is None:
+        ctx.set_out(op, "Out", x)
+        return
+    total = int(ctx.in1(op, "Y").shape[0])
+    seg = _segments(y_lens, total)
+    if x_lens is None:
+        # common seq2seq case: X rows map 1:1 to sequences; repeat row i
+        # to cover Y's sequence i (e.g. encoder state → decoder steps)
+        ctx.set_out(op, "Out", x[seg])
+        _set_out_lod(ctx, op, y_lens)
+        return
+    # x sequences of length 1: same gather through their start offsets
+    starts = _starts(x_lens)
+    ctx.set_out(op, "Out", x[starts[seg]])
+    _set_out_lod(ctx, op, y_lens)
+
+
+@register("sequence_reshape")
+def _sequence_reshape(ctx, op):
+    x = ctx.in1(op, "X")
+    new_dim = int(op.attr("new_dim"))
+    lengths = _lengths(ctx, op)
+    out = x.reshape(-1, new_dim)
+    ctx.set_out(op, "Out", out)
+    if lengths is not None:
+        old_dim = x.shape[1]
+        _set_out_lod(ctx, op, (lengths * old_dim) // new_dim)
+
+
+@register("sequence_slice")
+def _sequence_slice(ctx, op):
+    """Slice [offset, offset+length) of every sequence
+    (sequence_slice_op.cc). Offsets/Length are per-sequence [N,1] tensors."""
+    x = ctx.in1(op, "X")
+    offset = ctx.in1(op, "Offset").reshape(-1)
+    length = ctx.in1(op, "Length").reshape(-1)
+    lengths = _lengths(ctx, op)
+    if lengths is None:
+        lengths = jnp.asarray([x.shape[0]], jnp.int32)
+    starts = _starts(lengths)
+    t = x.shape[0]
+    seg = _segments(lengths, t)
+    pos_in_seq = jnp.arange(t) - starts[seg]
+    keep = (pos_in_seq >= offset[seg]) & (pos_in_seq < offset[seg] +
+                                          length[seg])
+    # stable partition: kept rows first, in order (static shape = t; callers
+    # read the first sum(length) rows via the @LOD lengths)
+    order = jnp.argsort(~keep, stable=True)
+    ctx.set_out(op, "Out", x[order])
+    _set_out_lod(ctx, op, length.astype(jnp.int32))
+
+
+@register("sequence_erase")
+def _sequence_erase(ctx, op):
+    """Remove tokens in `tokens` from each sequence (sequence_erase_op.cc).
+    Kept rows are stably compacted to the front; @LOD carries new lengths."""
+    x = ctx.in1(op, "X")
+    tokens = jnp.asarray(op.attr("tokens", []), x.dtype)
+    lengths = _lengths(ctx, op)
+    flat = x.reshape(-1) if x.ndim > 1 else x
+    keep = jnp.all(flat[:, None] != tokens[None, :], axis=1) \
+        if tokens.size else jnp.ones_like(flat, bool)
+    order = jnp.argsort(~keep, stable=True)
+    ctx.set_out(op, "Out", x[order])
+    if lengths is not None:
+        n = lengths.shape[0]
+        seg = _segments(lengths, flat.shape[0])
+        new_lens = jax.ops.segment_sum(keep.astype(jnp.int32), seg,
+                                       num_segments=n)
+        _set_out_lod(ctx, op, new_lens)
+
+
+@register("sequence_conv")
+def _sequence_conv(ctx, op):
+    """Context-window conv over each sequence (sequence_conv_op.cc):
+    out[t] = sum_k x[t + k - pad_start] @ W_k, zero beyond the sequence."""
+    x = ctx.in1(op, "X")                       # [T, D]
+    w = ctx.in1(op, "Filter")                  # [ctx_len*D, M]
+    ctx_len = int(op.attr("contextLength", 3))
+    ctx_start = int(op.attr("contextStart", -(ctx_len // 2)))
+    stride = int(op.attr("contextStride", 1))
+    assert stride == 1, "contextStride must be 1 (reference limitation too)"
+    lengths = _lengths(ctx, op)
+    t, d = x.shape
+    if lengths is None:
+        lengths = jnp.asarray([t], jnp.int32)
+    seg = _segments(lengths, t)
+    pieces = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(x, -off, axis=0)
+        # positions whose source crossed a sequence boundary are zero
+        src = jnp.arange(t) + off
+        valid = (src >= 0) & (src < t)
+        same_seq = seg[jnp.clip(src, 0, t - 1)] == seg
+        ok = (valid & same_seq)[:, None]
+        pieces.append(jnp.where(ok, shifted, 0.0))
+    ctx_mat = jnp.concatenate(pieces, axis=1)          # [T, ctx_len*D]
+    out = ctx_mat @ w
+    ctx.set_out(op, "Out", out)
+    _set_out_lod(ctx, op, lengths)
+
+
+@register("sequence_pad")
+def _sequence_pad(ctx, op):
+    """Flat LoD [T,D] + lengths → padded [N, maxlen, D]
+    (static maxlen from attr or T)."""
+    x = ctx.in1(op, "X")
+    lengths = _lengths(ctx, op)
+    maxlen = int(op.attr("padded_length", 0) or 0)
+    pad_value = ctx.in1(op, "PadValue", jnp.asarray(0.0, x.dtype))
+    if lengths is None:
+        out = x[None] if maxlen == 0 else x[None, :maxlen]
+        ctx.set_out(op, "Out", out)
+        ctx.set_out(op, "Length", jnp.asarray([x.shape[0]]))
+        return
+    n = lengths.shape[0]
+    t = x.shape[0]
+    if maxlen <= 0:
+        maxlen = t  # static upper bound
+    starts = _starts(lengths)
+    rows = starts[:, None] + jnp.arange(maxlen)[None, :]
+    valid = jnp.arange(maxlen)[None, :] < lengths[:, None]
+    gathered = x[jnp.clip(rows, 0, t - 1)]
+    mask = valid.reshape(n, maxlen, *([1] * (x.ndim - 1)))
+    out = jnp.where(mask, gathered, pad_value)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Length", lengths)
+
+
+@register("sequence_unpad")
+def _sequence_unpad(ctx, op):
+    """Padded [N, maxlen, D] + Length → flat [T, D] (+ @LOD lengths).
+    Rows are compacted stably; the flat buffer keeps the padded total size
+    (static shape), real content in the first sum(lengths) rows."""
+    x = ctx.in1(op, "X")
+    lengths = ctx.in1(op, "Length").reshape(-1).astype(jnp.int32)
+    n, maxlen = x.shape[0], x.shape[1]
+    flat = x.reshape((n * maxlen,) + x.shape[2:])
+    valid = (jnp.arange(maxlen)[None, :] < lengths[:, None]).reshape(-1)
+    order = jnp.argsort(~valid, stable=True)
+    ctx.set_out(op, "Out", flat[order])
+    _set_out_lod(ctx, op, lengths)
+
+
+@register("sequence_scatter")
+def _sequence_scatter(ctx, op):
+    x = ctx.in1(op, "X")
+    ids = ctx.in1(op, "Ids").reshape(-1).astype(jnp.int32)
+    updates = ctx.in1(op, "Updates")
+    ctx.set_out(op, "Out", x.at[ids].add(updates))

@@ -1,0 +1,103 @@
+"""Profiling API.
+
+Reference parity: python/paddle/fluid/profiler.py:33-76 (``profiler`` context
+manager, ``cuda_profiler``→``tpu_profiler``, ``reset_profiler``) and the host
+RecordEvent machinery (platform/profiler.h:26-107).
+
+TPU-first: device-side tracing delegates to the JAX profiler (XPlane →
+TensorBoard / Perfetto, the CUPTI-tracer equivalent); host-side per-run
+timing keeps the reference's sorted-summary-table semantics around compiled
+step boundaries (op-level events don't exist — ops are fused into one XLA
+computation; the step IS the op).
+"""
+
+import contextlib
+import time
+from collections import defaultdict
+
+__all__ = ["profiler", "tpu_profiler", "cuda_profiler", "reset_profiler",
+           "start_profiler", "stop_profiler", "RecordEvent"]
+
+_events = defaultdict(lambda: [0, 0.0])   # name -> [count, total_s]
+_enabled = False
+
+
+class RecordEvent:
+    """RAII timing marker (platform/profiler.h RecordEvent parity)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled:
+            ev = _events[self.name]
+            ev[0] += 1
+            ev[1] += time.perf_counter() - self._t0
+        return False
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def start_profiler(state="All"):
+    global _enabled
+    _enabled = True
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _enabled
+    _enabled = False
+    rows = [(name, cnt, tot, tot / cnt if cnt else 0.0)
+            for name, (cnt, tot) in _events.items()]
+    key = {"total": 2, "calls": 1, "name": 0, "ave": 3,
+           None: 2}.get(sorted_key, 2)
+    rows.sort(key=lambda r: r[key], reverse=key != 0)
+    lines = ["%-40s %10s %14s %14s" % ("Event", "Calls", "Total(s)",
+                                       "Avg(s)")]
+    for name, cnt, tot, avg in rows:
+        lines.append("%-40s %10d %14.6f %14.6f" % (name, cnt, tot, avg))
+    report = "\n".join(lines)
+    try:
+        with open(profile_path + ".txt", "w") as f:
+            f.write(report)
+    except OSError:
+        pass
+    print(report)
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    """Host summary + (state != 'CPU') JAX device trace to profile_path."""
+    trace_ctx = None
+    if state in ("All", "GPU", "TPU"):
+        try:
+            import jax
+            trace_ctx = jax.profiler.trace(profile_path)
+            trace_ctx.__enter__()
+        except Exception:
+            trace_ctx = None
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+        if trace_ctx is not None:
+            trace_ctx.__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def tpu_profiler(output_file, output_mode=None, config=None):
+    """Device-trace-only context (cuda_profiler parity for TPU)."""
+    import jax
+    with jax.profiler.trace(output_file):
+        yield
+
+
+cuda_profiler = tpu_profiler

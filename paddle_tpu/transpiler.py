@@ -1,0 +1,82 @@
+"""Program→Program rewrite passes.
+
+Reference parity:
+  * InferenceTranspiler (python/paddle/fluid/inference_transpiler.py:21):
+    fuse batch_norm into the preceding conv's weights for inference.
+  * memory_optimize / release_memory
+    (python/paddle/fluid/memory_optimization_transpiler.py:362): liveness
+    analysis for in-place buffer reuse. Under XLA this is the compiler's
+    job — buffer assignment + donation already reuse memory — so these are
+    intentional no-ops kept for API parity; state donation in the Executor
+    (donate_argnums) provides the in-place-update property the reference's
+    pass existed for.
+"""
+
+import numpy as np
+
+from .core.program import default_main_program
+from .core.scope import global_scope
+
+__all__ = ["InferenceTranspiler", "memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    """No-op under XLA (see module docstring). Returns the program."""
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """No-op under XLA (see module docstring)."""
+    return input_program
+
+
+class InferenceTranspiler:
+    """Fuses conv2d → batch_norm(is_test) into a single conv2d + bias add by
+    folding the BN affine transform into the filter, exactly the
+    inference_transpiler.py:21 optimization. Operates on scope values, so
+    call it after params are initialized/loaded."""
+
+    def transpile(self, program=None, place=None, scope=None):
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        block = program.global_block()
+        ops = block.ops
+        i = 0
+        while i < len(ops) - 1:
+            op = ops[i]
+            nxt = ops[i + 1]
+            if (op.type == "conv2d" and nxt.type == "batch_norm"
+                    and op.output("Output")
+                    and nxt.input("X") == op.output("Output")):
+                ops[i + 1] = self._fuse_conv_bn(block, scope, op, nxt)
+                program._bump_version()
+            i += 1
+        return program
+
+    @staticmethod
+    def _fuse_conv_bn(block, scope, conv_op, bn_op):
+        eps = bn_op.attr("epsilon", 1e-5)
+        filter_name = conv_op.input("Filter")[0]
+        w = np.asarray(scope.find_var(filter_name))
+        scale = np.asarray(scope.find_var(bn_op.input("Scale")[0]))
+        bias = np.asarray(scope.find_var(bn_op.input("Bias")[0]))
+        mean = np.asarray(scope.find_var(bn_op.input("Mean")[0]))
+        var = np.asarray(scope.find_var(bn_op.input("Variance")[0]))
+
+        inv_std = 1.0 / np.sqrt(var + eps)
+        alpha = scale * inv_std                      # [C_out]
+        scope.set(filter_name,
+                  (w * alpha[:, None, None, None]).astype(w.dtype))
+        new_bias = (bias - mean * alpha).astype(w.dtype)
+
+        # rewrite the BN output to a bias-add on the conv output, reusing the
+        # BN Bias var to carry the folded bias
+        bias_name = bn_op.input("Bias")[0]
+        scope.set(bias_name, new_bias)
+        conv_out = conv_op.output("Output")[0]
+        bn_out = bn_op.output("Y")[0]
+        from .core.program import Operator
+        return Operator(block, "elementwise_add",
+                        {"X": [conv_out], "Y": [bias_name]},
+                        {"Out": [bn_out]}, {"axis": 1})

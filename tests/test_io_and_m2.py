@@ -1,0 +1,209 @@
+"""M2 training-completeness tests: save/load, inference model round-trip,
+atomic checkpointing, LR schedules, nets composites, conv+bn inference
+fusion, metrics, profiler."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _mlp(img_dim=16, classes=4):
+    x = fluid.layers.data("x", [img_dim])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    pred = fluid.layers.fc(x, classes, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return x, label, pred, loss
+
+
+def test_save_load_params_roundtrip(tmp_path):
+    x, label, pred, loss = _mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = np.random.rand(8, 16).astype(np.float32)
+    y = np.random.randint(0, 4, (8, 1)).astype(np.int64)
+    exe.run(feed={"x": d, "label": y}, fetch_list=[loss])
+    # eval on a pruned program: the full program would also run the
+    # optimizer ops (whole-program semantics, like the reference)
+    eval_prog = fluid.default_main_program().prune([pred])
+    before, = exe.run(eval_prog, feed={"x": d}, fetch_list=[pred])
+
+    fluid.io.save_params(exe, str(tmp_path / "model"))
+    # clobber params, then restore
+    scope = fluid.global_scope()
+    for p in fluid.default_main_program().all_parameters():
+        scope.set(p.name, np.zeros_like(np.asarray(scope.find_var(p.name))))
+    fluid.io.load_params(exe, str(tmp_path / "model"))
+    after, = exe.run(eval_prog, feed={"x": d}, fetch_list=[pred])
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_save_load_combined_file(tmp_path):
+    x, label, pred, loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_persistables(exe, str(tmp_path), filename="all_params")
+    scope = fluid.global_scope()
+    names = [p.name for p in fluid.default_main_program().all_parameters()]
+    orig = {n: np.asarray(scope.find_var(n)).copy() for n in names}
+    for n in names:
+        scope.set(n, np.zeros_like(orig[n]))
+    fluid.io.load_persistables(exe, str(tmp_path), filename="all_params")
+    for n in names:
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), orig[n])
+
+
+def test_inference_model_roundtrip(tmp_path):
+    x, label, pred, loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = np.random.rand(4, 16).astype(np.float32)
+    eval_prog = fluid.default_main_program().prune([pred])
+    want, = exe.run(eval_prog, feed={"x": d}, fetch_list=[pred])
+    fluid.io.save_inference_model(str(tmp_path / "infer"), ["x"], [pred],
+                                  exe)
+    # fresh scope + program, as a separate serving process would have
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path / "infer"), exe)
+        assert feeds == ["x"]
+        got, = exe.run(prog, feed={"x": d}, fetch_list=fetches,
+                       scope=scope2)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_checkpoint_atomic_and_corrupt_recovery(tmp_path):
+    x, label, pred, loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ck = str(tmp_path / "ckpt")
+    fluid.io.save_checkpoint(ck, step=1)
+    scope = fluid.global_scope()
+    names = [p.name for p in fluid.default_main_program().all_parameters()]
+    vals1 = {n: np.asarray(scope.find_var(n)).copy() for n in names}
+    # step 2 checkpoint, then corrupt it — loader must fall back to step 1
+    scope.set(names[0], vals1[names[0]] + 1.0)
+    fluid.io.save_checkpoint(ck, step=2)
+    with open(os.path.join(ck, "ckpt-2.npz"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad\xbe\xef")
+    for n in names:
+        scope.set(n, np.zeros_like(vals1[n]))
+    step = fluid.io.load_checkpoint(ck)
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(scope.find_var(names[0])),
+                               vals1[names[0]])
+
+
+@pytest.mark.parametrize("decay_fn,kwargs,expect", [
+    ("exponential_decay", dict(learning_rate=1.0, decay_steps=2,
+                               decay_rate=0.5), [1.0, 0.7071, 0.5]),
+    ("natural_exp_decay", dict(learning_rate=1.0, decay_steps=1,
+                               decay_rate=0.5),
+     [1.0, np.exp(-0.5), np.exp(-1.0)]),
+    ("inverse_time_decay", dict(learning_rate=1.0, decay_steps=1,
+                                decay_rate=1.0), [1.0, 0.5, 1 / 3]),
+    ("piecewise_decay", dict(boundaries=[1, 2], values=[1.0, 0.5, 0.1]),
+     [1.0, 0.5, 0.1]),
+])
+def test_lr_schedules(decay_fn, kwargs, expect):
+    lr = getattr(fluid.layers, decay_fn)(**kwargs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got = []
+    for _ in range(len(expect)):
+        v, = exe.run(feed={}, fetch_list=[lr])
+        got.append(float(np.asarray(v).reshape(-1)[0]))
+    np.testing.assert_allclose(got, expect, rtol=1e-3)
+
+
+def test_noam_decay_peaks_at_warmup():
+    lr = fluid.layers.noam_decay(d_model=64, warmup_steps=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = [float(np.asarray(exe.run(feed={}, fetch_list=[lr])[0]).reshape(-1)[0])
+            for _ in range(6)]
+    assert np.argmax(vals) == 2          # peak at step == warmup_steps
+    assert vals[3] > vals[4] > vals[5]   # then decays
+
+
+def test_scaled_dot_product_attention_runs():
+    q = fluid.layers.data("q", [6, 16])
+    k = fluid.layers.data("k", [6, 16])
+    v = fluid.layers.data("v", [6, 16])
+    ctx = fluid.nets.scaled_dot_product_attention(q, k, v, num_heads=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    out, = exe.run(feed={"q": rng.rand(2, 6, 16).astype(np.float32),
+                         "k": rng.rand(2, 6, 16).astype(np.float32),
+                         "v": rng.rand(2, 6, 16).astype(np.float32)},
+                   fetch_list=[ctx])
+    assert out.shape == (2, 6, 16)
+    # attention over softmax weights keeps values in the convex hull
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+def test_glu():
+    x = fluid.layers.data("x", [8])
+    out = fluid.nets.glu(x, dim=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = np.random.rand(3, 8).astype(np.float32)
+    got, = exe.run(feed={"x": d}, fetch_list=[out])
+    a, b = d[:, :4], d[:, 4:]
+    np.testing.assert_allclose(got, a / (1 + np.exp(-b)), rtol=1e-5)
+
+
+def test_inference_transpiler_fuses_conv_bn():
+    img = fluid.layers.data("img", [3, 8, 8])
+    conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                               bias_attr=False)
+    bn = fluid.layers.batch_norm(conv, act=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # give BN non-trivial statistics
+    scope = fluid.global_scope()
+    prog = fluid.default_main_program()
+    bn_op = [op for op in prog.global_block().ops
+             if op.type == "batch_norm"][0]
+    rng = np.random.RandomState(3)
+    scope.set(bn_op.input("Mean")[0], rng.rand(4).astype(np.float32))
+    scope.set(bn_op.input("Variance")[0],
+              (0.5 + rng.rand(4)).astype(np.float32))
+    d = rng.rand(2, 3, 8, 8).astype(np.float32)
+    infer_prog = prog.prune([bn]).clone(for_test=True)
+    want, = exe.run(infer_prog, feed={"img": d}, fetch_list=[bn.name])
+
+    t = fluid.InferenceTranspiler()
+    t.transpile(infer_prog)
+    types = [op.type for op in infer_prog.global_block().ops]
+    assert "batch_norm" not in types
+    got, = exe.run(infer_prog, feed={"img": d}, fetch_list=[bn.name])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_metrics_accumulators():
+    m = fluid.metrics.Accuracy()
+    m.update(0.5, 10)
+    m.update(1.0, 10)
+    assert abs(m.eval() - 0.75) < 1e-9
+    p = fluid.metrics.Precision()
+    p.update(np.array([1, 1, 0, 1]), np.array([1, 0, 0, 1]))
+    assert abs(p.eval() - 2 / 3) < 1e-9
+    auc = fluid.metrics.Auc(num_thresholds=200)
+    preds = np.array([0.1, 0.4, 0.35, 0.8])
+    labels = np.array([0, 0, 1, 1])
+    auc.update(preds, labels)
+    assert 0.6 < auc.eval() < 0.9
+
+
+def test_profiler_summary(capsys):
+    with fluid.profiler.profiler("CPU", "total", "/tmp/ptpu_prof"):
+        with fluid.profiler.RecordEvent("stepA"):
+            pass
+    outp = capsys.readouterr().out
+    assert "stepA" in outp
