@@ -16,6 +16,20 @@ Usage mirrors the reference:
     exe = fluid.Executor(fluid.TPUPlace(0))
 """
 
+import os as _os
+
+# Restore standard JAX_PLATFORMS semantics: the axon TPU plugin prepends
+# itself to jax_platforms even when the user exported JAX_PLATFORMS=cpu.
+# Honor the env var if the backend isn't initialized yet.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    try:
+        if _jax.config.jax_platforms != _os.environ["JAX_PLATFORMS"]:
+            _jax.config.update("jax_platforms",
+                               _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 from . import ops as _ops_registration  # noqa: F401  (registers lowerings)
 from . import layers  # noqa: F401
 from . import initializer  # noqa: F401
@@ -43,6 +57,8 @@ from . import profiler  # noqa: F401
 from .transpiler import (  # noqa: F401
     InferenceTranspiler, memory_optimize, release_memory,
 )
+from . import parallel  # noqa: F401
+from .parallel import ParallelExecutor  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -21,8 +21,11 @@ from .nn import (  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .math_ops import scale  # noqa: F401
 from .sequence_layers import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from . import control_flow  # noqa: F401
+from .rnn_layers import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401
     argmax, argmin, assign, cast, concat, create_global_var, create_tensor,
     expand, fill_constant, fill_constant_batch_size_like, gather, increment,
-    ones, reshape, scatter, split, sums, transpose, zeros,
+    ones, reshape, scatter, slice, split, sums, transpose, zeros,
 )

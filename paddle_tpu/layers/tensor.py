@@ -236,3 +236,26 @@ def increment(x, value=1.0, in_place=True):
     helper.append_op(type="increment", inputs={"X": [x]},
                      outputs={"Out": [out]}, attrs={"step": float(value)})
     return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    """fluid.layers.slice parity (slice_op.cc)."""
+    helper = LayerHelper("slice", name=name)
+    shape = None
+    if input.shape is not None:
+        shape = list(input.shape)
+        for ax, s, e in zip(axes, starts, ends):
+            n = shape[ax]
+            if n is not None and n >= 0:
+                s2 = s if s >= 0 else n + s
+                e2 = min(e if e >= 0 else n + e, n)
+                shape[ax] = max(0, e2 - s2)
+            else:
+                shape[ax] = -1
+        shape = tuple(shape)
+    out = helper.create_variable_for_type_inference(input.dtype, shape=shape)
+    helper.append_op(type="slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out

@@ -3,8 +3,10 @@ lowerings (the analog of the reference's static REGISTER_OPERATOR blocks)."""
 
 from . import (  # noqa: F401
     activations,
+    control_flow,
     conv,
     elementwise,
+    rnn_ops,
     loss,
     math,
     metrics_ops,

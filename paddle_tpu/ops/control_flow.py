@@ -1,0 +1,246 @@
+"""Control-flow ops: recurrent (scan), while, conditional_block.
+
+Reference parity: operators/recurrent_op.cc:53-310 (step scopes + ex-state
+linkage), while_op.cc, conditional_block_op.cc.
+
+TPU-first: the reference runs sub-blocks with a per-step Scope tree and
+hand-written gradient ops. Here a sub-block is traced into a step function
+and driven by ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` — XLA compiles
+one fused loop body, and reverse-mode autodiff of scan replaces the
+reference's RecurrentGradOp entirely. Variable-length sequences use masking
+(carry holds the last real state once a sequence ends), the static-shape
+equivalent of shrink_rnn_memory.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import registry
+from ..core.registry import register, LowerContext
+
+
+def _trace_block(ctx, block, env):
+    from ..core.executor import _lower_op
+    sctx = LowerContext(env, ctx._rng_fn, is_test=ctx.is_test,
+                        executor=ctx.executor, block=block)
+    for op2 in block.ops:
+        _lower_op(sctx, op2)
+    return env
+
+
+@register("recurrent")
+def _recurrent(ctx, op):
+    """Scan a sub-block over the time axis.
+
+    inputs:  "inputs" outer sequence vars; "initial_states" state boot vars;
+             optional "sequence_length" lengths [B]
+    outputs: "outputs" stacked step outputs; "final_states"
+    attrs:   sub_block, inner_input_names, inner_state_names,
+             inner_state_out_names, inner_output_names, time_major, reverse
+    """
+    block = op.attr("sub_block")
+    inner_inputs = op.attr("inner_input_names") or []
+    inner_states = op.attr("inner_state_names") or []
+    inner_state_outs = op.attr("inner_state_out_names") or []
+    inner_outputs = op.attr("inner_output_names") or []
+    time_major = op.attr("time_major", True)
+    reverse = op.attr("reverse", False)
+
+    xs = [ctx.get(n) for n in op.input("inputs")]
+    if not time_major:
+        xs = [jnp.moveaxis(x, 1, 0) for x in xs]           # → [T, B, ...]
+    t_len = xs[0].shape[0] if xs else int(op.attr("max_len"))
+    init = tuple(ctx.get(n) for n in op.input("initial_states"))
+
+    lens = None
+    if op.input("sequence_length"):
+        lens = ctx.get(op.input("sequence_length")[0]).reshape(-1)
+
+    base_env = dict(ctx.env)
+
+    def step(carry, scanned):
+        t_idx, xt = scanned
+        env = dict(base_env)
+        for name, v in zip(inner_states, carry):
+            env[name] = v
+        for name, v in zip(inner_inputs, xt):
+            env[name] = v
+        _trace_block(ctx, block, env)
+        new_carry = tuple(env[n] for n in inner_state_outs)
+        if lens is not None:
+            # masked update: finished sequences keep their last state
+            # (inputs are end-padded, so real steps are t < len in both
+            # scan directions)
+            alive = (t_idx < lens)
+            new_carry = tuple(
+                jnp.where(alive.reshape((-1,) + (1,) * (nc.ndim - 1)), nc, c)
+                for nc, c in zip(new_carry, carry))
+        outs = tuple(env[n] for n in inner_outputs)
+        if lens is not None:
+            alive = (t_idx < lens)
+            outs = tuple(
+                jnp.where(alive.reshape((-1,) + (1,) * (o.ndim - 1)), o,
+                          jnp.zeros_like(o)) for o in outs)
+        return new_carry, outs
+
+    tidx = jnp.arange(t_len)
+    final, ys = lax.scan(step, init, (tidx, tuple(xs)), reverse=reverse)
+
+    for name, y in zip(op.output("outputs"), ys):
+        ctx.env[name] = y if time_major else jnp.moveaxis(y, 0, 1)
+    for name, s in zip(op.output("final_states"), final):
+        ctx.env[name] = s
+
+
+@register("while")
+def _while(ctx, op):
+    """Run sub-block until the condition var is false (while_op.cc).
+
+    Carried vars are the block's written-and-read outer vars, listed in attr
+    ``carry_names``. Non-differentiable (lax.while_loop); RNN-style training
+    loops lower through ``recurrent`` instead, like the reference's
+    DynamicRNN lowers through RecurrentOp step scopes.
+    """
+    block = op.attr("sub_block")
+    cond_name = op.input("Condition")[0]
+    carry_names = list(op.attr("carry_names") or [])
+    max_iters = op.attr("max_iters")  # optional safety bound
+
+    base_env = dict(ctx.env)
+    init = tuple(ctx.get(n) for n in carry_names) + \
+        (ctx.get(cond_name).reshape(()), jnp.asarray(0, jnp.int32))
+
+    def cond_fn(carry):
+        ok = carry[-2].astype(bool)
+        if max_iters:
+            ok = jnp.logical_and(ok, carry[-1] < max_iters)
+        return ok
+
+    def body_fn(carry):
+        env = dict(base_env)
+        for name, v in zip(carry_names, carry[:-2]):
+            env[name] = v
+        _trace_block(ctx, block, env)
+        new = tuple(env[n] for n in carry_names)
+        return new + (env[cond_name].reshape(()).astype(init[-2].dtype),
+                      carry[-1] + 1)
+
+    final = lax.while_loop(cond_fn, body_fn, init)
+    for name, v in zip(carry_names, final[:-2]):
+        ctx.env[name] = v
+    ctx.env[cond_name] = final[-2]
+
+
+@register("conditional_block")
+def _conditional_block(ctx, op):
+    """Trace the sub-block under lax.cond on a scalar condition
+    (conditional_block_op.cc). Vars written by the block must pre-exist in
+    env (else-branch passes them through unchanged)."""
+    block = op.attr("sub_block")
+    cond = ctx.get(op.input("Condition")[0]).reshape(())
+    out_names = list(op.attr("written_names") or op.output("Out") or [])
+    base_env = dict(ctx.env)
+
+    missing = [n for n in out_names if n not in base_env]
+    if missing:
+        raise ValueError(
+            "conditional_block outputs %s have no pre-set value for the "
+            "false branch; assign defaults before the block" % missing)
+
+    def true_fn(vals):
+        env = dict(base_env)
+        _trace_block(ctx, block, env)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(vals):
+        return vals
+
+    init = tuple(base_env[n] for n in out_names)
+    outs = lax.cond(cond.astype(bool), true_fn, false_fn, init)
+    for n, v in zip(out_names, outs):
+        ctx.env[n] = v
+
+
+@register("select_rows_by_mask")
+def _select_rows_by_mask(ctx, op):
+    """Row-wise merge for IfElse (the static-shape replacement for the
+    reference's split_lod_tensor/merge_lod_tensor row partitioning): output
+    rows come from TrueOut where mask else FalseOut."""
+    mask = ctx.in1(op, "Mask").reshape(-1).astype(bool)
+    t = ctx.in1(op, "TrueOut")
+    f = ctx.in1(op, "FalseOut")
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    ctx.set_out(op, "Out", jnp.where(m, t, f))
+
+
+# -- LoDTensorArray ops (tensor_array_read_write.cc, lod_array_length) -----
+# Arrays are represented as stacked tensors in env plus a python-side list
+# during tracing when indices are trace-time constants.
+
+@register("write_to_array")
+def _write_to_array(ctx, op):
+    arr_name = ctx.out_name(op, "Out")
+    x = ctx.in1(op, "X")
+    lst = ctx.env.get(arr_name + "@ARRAY")
+    if lst is None:
+        lst = []
+    i = ctx.in1(op, "I")
+    idx = int(jax.core.concrete_or_error(
+        None, i.reshape(()), "write_to_array index must be trace-time known"))
+    lst = list(lst)
+    if idx == len(lst):
+        lst.append(x)
+    else:
+        while len(lst) <= idx:
+            lst.append(jnp.zeros_like(x))
+        lst[idx] = x
+    ctx.env[arr_name + "@ARRAY"] = lst
+    ctx.env[arr_name] = jnp.stack(lst)
+
+
+@register("read_from_array")
+def _read_from_array(ctx, op):
+    arr_name = op.input("X")[0]
+    i = ctx.in1(op, "I")
+    lst = ctx.env.get(arr_name + "@ARRAY")
+    idx = int(jax.core.concrete_or_error(
+        None, i.reshape(()), "read_from_array index must be trace-time known"))
+    if lst is not None:
+        ctx.set_out(op, "Out", lst[idx])
+    else:
+        ctx.set_out(op, "Out", ctx.get(arr_name)[idx])
+
+
+@register("lod_array_length")
+def _lod_array_length(ctx, op):
+    arr_name = op.input("X")[0]
+    lst = ctx.env.get(arr_name + "@ARRAY")
+    n = len(lst) if lst is not None else ctx.get(arr_name).shape[0]
+    ctx.set_out(op, "Out", jnp.asarray([n], jnp.int64))
+
+
+@register("shrink_rnn_memory")
+def _shrink_rnn_memory(ctx, op):
+    # Static-shape parity: masking in `recurrent` already preserves final
+    # states, so shrink is an identity on the padded batch.
+    ctx.set_out(op, "Out", ctx.in1(op, "X"))
+
+
+@register("max_sequence_len")
+def _max_sequence_len(ctx, op):
+    lens = ctx.in1(op, "RankTable")
+    ctx.set_out(op, "Out", jnp.max(lens).reshape(1).astype(jnp.int64))
+
+
+@register("lod_rank_table")
+def _lod_rank_table(ctx, op):
+    # The rank table is (seq index, length) sorted by decreasing length
+    # (framework/lod_rank_table.h). Here: just the lengths vector; ops that
+    # consume it (max_sequence_len) reduce over it.
+    x_name = op.input("X")[0]
+    lens = ctx.maybe_get(x_name + "@LOD")
+    if lens is None:
+        x = ctx.get(x_name)
+        lens = jnp.asarray([x.shape[0]], jnp.int32)
+    ctx.set_out(op, "Out", lens)

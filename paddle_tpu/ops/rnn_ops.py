@@ -1,0 +1,266 @@
+"""Fused recurrent ops: LSTM / GRU families.
+
+Reference parity: operators/{lstm,lstmp,gru,lstm_unit,gru_unit}_op.cc and
+the fused GPU kernels in operators/math/detail/ + cuda/src/hl_lstm*.
+
+TPU-first: one ``lax.scan`` whose body is a single [B,4D] gate matmul — the
+shape the MXU wants — over a *padded* batch with length masking, instead of
+the reference's sequence2batch reordering of LoD batches. Inputs arrive in
+flat-LoD layout ([T_total, ...] + ``@LOD`` lengths) and are padded/unpadded
+in-graph; everything stays differentiable through scan.
+
+Gate layouts match the reference ops' weight packing:
+  lstm_op.cc: gates = x_proj + h @ W, W [D, 4D] packed [i, f, c̃, o]
+              (bias may be [1,7D] with peephole weights W_ic, W_fc, W_oc)
+  gru_op.cc:  gate_weight [D, 2D] packed [u, r]; candidate_weight [D, D]
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+def _act(name):
+    import jax
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda x: x}[name]
+
+
+def _pad_from_lod(ctx, op, slot="Input"):
+    """flat [T,D] + lengths → (padded [B,Tmax,D], lengths, total_T)."""
+    x = ctx.in1(op, slot)
+    lens = ctx.maybe_get(op.input(slot)[0] + "@LOD")
+    t = x.shape[0]
+    if lens is None:
+        return x[None], jnp.asarray([t], jnp.int32), t
+    n = lens.shape[0]
+    maxlen = t  # static upper bound; masking handles the rest
+    starts = jnp.cumsum(lens) - lens
+    rows = starts[:, None] + jnp.arange(maxlen)[None, :]
+    valid = jnp.arange(maxlen)[None, :] < lens[:, None]
+    padded = jnp.where(valid.reshape(n, maxlen, *([1] * (x.ndim - 1))),
+                       x[jnp.clip(rows, 0, t - 1)], 0)
+    return padded, lens, t
+
+
+def _unpad_to_lod(padded, lens, total):
+    """[B,Tmax,D] + lengths → flat [T,D] stably compacted."""
+    n, maxlen = padded.shape[0], padded.shape[1]
+    flat = padded.reshape((n * maxlen,) + padded.shape[2:])
+    valid = (jnp.arange(maxlen)[None, :] < lens[:, None]).reshape(-1)
+    order = jnp.argsort(~valid, stable=True)
+    return flat[order][:total]
+
+
+@register("lstm")
+def _lstm(ctx, op):
+    """dynamic_lstm: Input [T,4D] (already x@Wx), Weight [D,4D], Bias [1,4D]
+    or [1,7D] w/ peepholes."""
+    use_peepholes = op.attr("use_peepholes", True)
+    is_reverse = op.attr("is_reverse", False)
+    ga = _act(op.attr("gate_activation", "sigmoid"))
+    ca = _act(op.attr("cell_activation", "tanh"))
+    ha = _act(op.attr("candidate_activation", "tanh"))
+
+    xp, lens, total = _pad_from_lod(ctx, op, "Input")   # [B,T,4D]
+    w = ctx.in1(op, "Weight")                           # [D,4D]
+    d = w.shape[0]
+    bias = ctx.in1(op, "Bias")
+    b_gate = bias[:, :4 * d] if bias is not None else 0.0
+    if use_peepholes and bias is not None and bias.shape[-1] >= 7 * d:
+        w_ic = bias[0, 4 * d:5 * d]
+        w_fc = bias[0, 5 * d:6 * d]
+        w_oc = bias[0, 6 * d:7 * d]
+    else:
+        w_ic = w_fc = w_oc = None
+
+    n, tmax = xp.shape[0], xp.shape[1]
+    h0 = ctx.in1(op, "H0", jnp.zeros((n, d), xp.dtype))
+    c0 = ctx.in1(op, "C0", jnp.zeros((n, d), xp.dtype))
+
+    xs = jnp.moveaxis(xp, 1, 0)                          # [T,B,4D]
+    tidx = jnp.arange(tmax)
+
+    def step(carry, scanned):
+        h, c = carry
+        t, xt = scanned
+        gates = xt + h @ w + b_gate                      # [B,4D]
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = ga(gi)
+        f = ga(gf)
+        c_new = f * c + i * ca(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = ga(go)
+        h_new = o * ha(c_new)
+        alive = (t < lens)[:, None]
+        h_new = jnp.where(alive, h_new, h)
+        c_new = jnp.where(alive, c_new, c)
+        out = jnp.where(alive, h_new, jnp.zeros_like(h_new))
+        return (h_new, c_new), (out, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (tidx, xs),
+                                reverse=is_reverse)
+    hs = jnp.moveaxis(hs, 0, 1)                          # [B,T,D]
+    cs = jnp.moveaxis(cs, 0, 1)
+    out_name = ctx.out_name(op, "Hidden")
+    ctx.env[out_name] = _unpad_to_lod(hs, lens, total)
+    ctx.env[out_name + "@LOD"] = lens
+    cell_name = ctx.out_name(op, "Cell")
+    if cell_name:
+        ctx.env[cell_name] = _unpad_to_lod(cs, lens, total)
+        ctx.env[cell_name + "@LOD"] = lens
+
+
+@register("lstmp")
+def _lstmp(ctx, op):
+    """LSTM with recurrent projection (lstmp_op.cc): hidden h is projected
+    to r = proj_act(h @ W_proj) which feeds back into the gates."""
+    is_reverse = op.attr("is_reverse", False)
+    ga = _act(op.attr("gate_activation", "sigmoid"))
+    ca = _act(op.attr("cell_activation", "tanh"))
+    ha = _act(op.attr("candidate_activation", "tanh"))
+    pa = _act(op.attr("proj_activation", "tanh"))
+
+    use_peepholes = op.attr("use_peepholes", True)
+    xp, lens, total = _pad_from_lod(ctx, op, "Input")    # [B,T,4D]
+    w = ctx.in1(op, "Weight")                            # [P,4D]
+    w_proj = ctx.in1(op, "ProjWeight")                   # [D,P]
+    d = w_proj.shape[0]
+    p = w_proj.shape[1]
+    bias = ctx.in1(op, "Bias")
+    b_gate = bias[:, :4 * d] if bias is not None else 0.0
+    if use_peepholes and bias is not None and bias.shape[-1] >= 7 * d:
+        w_ic = bias[0, 4 * d:5 * d]
+        w_fc = bias[0, 5 * d:6 * d]
+        w_oc = bias[0, 6 * d:7 * d]
+    else:
+        w_ic = w_fc = w_oc = None
+
+    n, tmax = xp.shape[0], xp.shape[1]
+    r0 = jnp.zeros((n, p), xp.dtype)
+    c0 = jnp.zeros((n, d), xp.dtype)
+    xs = jnp.moveaxis(xp, 1, 0)
+    tidx = jnp.arange(tmax)
+
+    def step(carry, scanned):
+        r, c = carry
+        t, xt = scanned
+        gates = xt + r @ w + b_gate
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i, f = ga(gi), ga(gf)
+        c_new = f * c + i * ca(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = ga(go)
+        h_new = o * ha(c_new)
+        r_new = pa(h_new @ w_proj)
+        alive = (t < lens)[:, None]
+        r_new = jnp.where(alive, r_new, r)
+        c_new = jnp.where(alive, c_new, c)
+        return (r_new, c_new), (jnp.where(alive, r_new, 0.0), c_new)
+
+    _, (rs, cs) = lax.scan(step, (r0, c0), (tidx, xs), reverse=is_reverse)
+    rs = jnp.moveaxis(rs, 0, 1)
+    out_name = ctx.out_name(op, "Projection")
+    ctx.env[out_name] = _unpad_to_lod(rs, lens, total)
+    ctx.env[out_name + "@LOD"] = lens
+
+
+@register("gru")
+def _gru(ctx, op):
+    """dynamic_gru: Input [T,3D] (= x@Wx), Weight packed [D, 2D] update/reset
+    + [D, D] candidate (gru_op.cc layout: Weight is [D, 3D] with the first
+    2D columns the u/r gates)."""
+    is_reverse = op.attr("is_reverse", False)
+    ga = _act(op.attr("gate_activation", "sigmoid"))
+    ca = _act(op.attr("activation", "tanh"))
+    origin_mode = op.attr("origin_mode", False)
+
+    xp, lens, total = _pad_from_lod(ctx, op, "Input")    # [B,T,3D]
+    w = ctx.in1(op, "Weight")                            # [D,3D]
+    d = w.shape[0]
+    w_gate = w[:, :2 * d]
+    w_cand = w[:, 2 * d:]
+    bias = ctx.in1(op, "Bias")
+    b = bias if bias is not None else jnp.zeros((1, 3 * d), xp.dtype)
+
+    n, tmax = xp.shape[0], xp.shape[1]
+    h0 = ctx.in1(op, "H0", jnp.zeros((n, d), xp.dtype))
+    xs = jnp.moveaxis(xp, 1, 0)
+    tidx = jnp.arange(tmax)
+
+    def step(h, scanned):
+        t, xt = scanned
+        xu, xr, xc = xt[:, :d], xt[:, d:2 * d], xt[:, 2 * d:]
+        gh = h @ w_gate                                  # [B,2D]
+        u = ga(xu + gh[:, :d] + b[:, :d])
+        r = ga(xr + gh[:, d:] + b[:, d:2 * d])
+        c = ca(xc + (r * h) @ w_cand + b[:, 2 * d:])
+        if origin_mode:
+            h_new = u * h + (1 - u) * c
+        else:
+            h_new = (1 - u) * h + u * c
+        alive = (t < lens)[:, None]
+        h_new = jnp.where(alive, h_new, h)
+        return h_new, jnp.where(alive, h_new, 0.0)
+
+    _, hs = lax.scan(step, h0, (tidx, xs), reverse=is_reverse)
+    hs = jnp.moveaxis(hs, 0, 1)
+    out_name = ctx.out_name(op, "Hidden")
+    ctx.env[out_name] = _unpad_to_lod(hs, lens, total)
+    ctx.env[out_name + "@LOD"] = lens
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, op):
+    """Single-step LSTM cell (lstm_unit_op.cc): X = gates [B,4D], C_prev."""
+    x = ctx.in1(op, "X")
+    c_prev = ctx.in1(op, "C_prev")
+    forget_bias = op.attr("forget_bias", 0.0)
+    import jax
+    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    ctx.set_out(op, "C", c)
+    ctx.set_out(op, "H", h)
+
+
+@register("gru_unit")
+def _gru_unit(ctx, op):
+    """Single-step GRU cell (gru_unit_op.cc): Input [B,3D] = x proj,
+    HiddenPrev [B,D], Weight [D,3D]."""
+    import jax
+    x = ctx.in1(op, "Input")
+    h_prev = ctx.in1(op, "HiddenPrev")
+    w = ctx.in1(op, "Weight")
+    bias = ctx.in1(op, "Bias")
+    d = h_prev.shape[-1]
+    ga = _act({1: "sigmoid", 2: "tanh", 0: "identity",
+               3: "relu"}.get(op.attr("gate_activation", 1), "sigmoid")
+              if isinstance(op.attr("gate_activation", 1), int)
+              else op.attr("gate_activation"))
+    ca = _act({1: "sigmoid", 2: "tanh", 0: "identity",
+               3: "relu"}.get(op.attr("activation", 2), "tanh")
+              if isinstance(op.attr("activation", 2), int)
+              else op.attr("activation"))
+    if bias is not None:
+        x = x + bias
+    xu, xr, xc = x[:, :d], x[:, d:2 * d], x[:, 2 * d:]
+    gh = h_prev @ w[:, :2 * d]
+    u = ga(xu + gh[:, :d])
+    r = ga(xr + gh[:, d:])
+    c = ca(xc + (r * h_prev) @ w[:, 2 * d:])
+    h = u * h_prev + (1 - u) * c
+    ctx.set_out(op, "Gate", jnp.concatenate([u, r, c], axis=-1))
+    ctx.set_out(op, "ResetHiddenPrev", r * h_prev)
+    ctx.set_out(op, "Hidden", h)

@@ -287,7 +287,9 @@ def _shape(ctx, op):
 @register("increment")
 def _increment(ctx, op):
     x = ctx.in1(op, "X")
-    ctx.set_out(op, "Out", x + op.attr("step", 1.0))
+    # keep x's dtype: int counters must stay int (a python-float step would
+    # silently promote and break while-loop carry types)
+    ctx.set_out(op, "Out", x + jnp.asarray(op.attr("step", 1.0), x.dtype))
 
 
 @register("multiplex")

@@ -1,0 +1,26 @@
+"""SPMD parallel execution over a TPU device mesh.
+
+Replaces the reference's multi-device stack (SURVEY.md §2.7):
+  * ParallelExecutor + NCCL allreduce op-handles
+    (parallel_executor.cc:54-203, nccl_all_reduce_op_handle.cc) →
+    ``ParallelExecutor`` here: the SAME traced step function jitted with
+    sharded inputs over a ``jax.sharding.Mesh``; XLA inserts the gradient
+    all-reduces (and overlaps them with compute, which the reference's
+    per-grad NCCL insertion approximated by hand).
+  * NCCLContextMap / ncclCommInitAll → the Mesh itself (ICI topology).
+  * BCastParamsToGPUs → replicated device_put of the initial state.
+  * parallel_do / MultiGradientMachine → the dp axis of the mesh.
+Beyond the reference (required for TPU scale): tensor/pipeline/sequence/
+expert parallelism via sharding hints + shard_map collectives (see ring.py,
+pipeline.py, moe.py).
+"""
+
+from .mesh import (  # noqa: F401
+    make_mesh, default_mesh, set_default_mesh, shard, sharding_hint,
+    DistributedStrategy,
+)
+from .executor import ParallelExecutor  # noqa: F401
+from . import collective  # noqa: F401
+from .ring import ring_attention, ulysses_attention  # noqa: F401
+from .pipeline import gpipe  # noqa: F401
+from .moe import moe_ffn, top1_gating  # noqa: F401

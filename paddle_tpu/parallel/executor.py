@@ -1,0 +1,136 @@
+"""ParallelExecutor: SPMD data(+tensor)-parallel program execution.
+
+Reference parity: python/paddle/fluid/parallel_executor.py:25-130 +
+framework/parallel_executor.cc:54-203. The reference replicates the graph
+per GPU, broadcasts params, splits the feed batch (SplitLoDTensor) and
+inserts NCCL all-reduce per gradient. Here: ONE jitted step function with
+input shardings — batch feeds sharded on the mesh's ``dp`` axis, state
+replicated (or sharded by `parallel.shard` hints for TP) — and XLA GSPMD
+derives every collective, overlapped with compute.
+"""
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import (make_mesh, default_mesh, set_default_mesh,
+                   spec_to_named_sharding)
+from ..core.program import default_main_program, Variable
+from ..core.scope import global_scope
+from ..core.executor import Executor, as_numpy, _feed_signature
+from ..core.lod import LoDTensor
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, num_trainers=1, trainer_id=0,
+                 mesh=None, scope=None, use_tpu=True, **kwargs):
+        self.mesh = mesh or default_mesh() or make_mesh()
+        if default_mesh() is None:
+            set_default_mesh(self.mesh)
+        self._program = main_program or default_main_program()
+        self._scope = scope or global_scope()
+        self._exe = Executor.__new__(Executor)
+        from ..core.places import TPUPlace, CPUPlace
+        dev = np.ravel(self.mesh.devices)[0]
+        self._exe.place = (TPUPlace(0) if dev.platform == "tpu"
+                           else CPUPlace())
+        self._exe._cache = {}
+        self._exe._rng_counter = 0
+        self._cache = {}
+        self._loss_name = loss_name
+
+    @property
+    def device_count(self):
+        return int(np.prod(self.mesh.devices.shape))
+
+    def _data_sharding(self):
+        axes = [a for a in ("dp",) if a in self.mesh.axis_names]
+        return NamedSharding(self.mesh,
+                             PartitionSpec(axes[0] if axes else None))
+
+    def _state_sharding(self, name):
+        spec = self._program._sharding_hints.get(name)
+        return spec_to_named_sharding(self.mesh, spec)
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = dict(feed or feed_dict or {})
+        program = self._program
+        scope = self._scope
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else str(f)
+            for f in (fetch_list or []))
+
+        dp = 1
+        if "dp" in self.mesh.axis_names:
+            dp = self.mesh.shape["dp"]
+        feed_arrays = {}
+        lod_keys = set()
+        for k, v in feed.items():
+            if isinstance(v, LoDTensor):
+                # ragged token buffers keep a replicated layout (their row
+                # count is data-dependent); GSPMD re-shards downstream
+                lengths = v.recursive_sequence_lengths()[-1] if v.lod else []
+                feed_arrays[k] = v.data
+                lod_keys.add(k)
+                if lengths:
+                    feed_arrays[k + "@LOD"] = np.asarray(lengths, np.int32)
+                    lod_keys.add(k + "@LOD")
+            else:
+                feed_arrays[k] = np.asarray(v) \
+                    if not isinstance(v, jax.Array) else v
+        for k, v in feed_arrays.items():
+            if k in lod_keys:
+                continue
+            if v.ndim >= 1 and v.shape[0] % dp != 0:
+                raise ValueError(
+                    "feed %r batch dim %d not divisible by dp=%d "
+                    "(SplitLoDTensor parity requires equal chunks)"
+                    % (k, v.shape[0], dp))
+
+        persistable = [v.name for v in program.global_block().vars.values()
+                       if v.persistable]
+        state = {n: scope.find_var(n) for n in persistable
+                 if scope.find_var(n) is not None}
+        state_keys = tuple(sorted(state))
+
+        hints = tuple(sorted(
+            (k, tuple(v)) for k, v in program._sharding_hints.items()))
+        key = (program, program._version, _feed_signature(feed_arrays),
+               fetch_names, state_keys, hints)
+        entry = self._cache.get(key)
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        if entry is None:
+            fn = self._exe._build(program, tuple(sorted(feed_arrays)),
+                                  fetch_names, state_keys)
+            data_sh = self._data_sharding()
+            state_sh = {n: self._state_sharding(n) for n in state_keys}
+            in_shardings = (state_sh,
+                            {k: (repl if k in lod_keys else data_sh)
+                             for k in feed_arrays},
+                            repl)
+            entry = jax.jit(fn, in_shardings=in_shardings,
+                            donate_argnums=(0,))
+            self._cache[key] = entry
+
+        rng_key = jax.random.key(
+            np.uint32(program.random_seed * 1000003
+                      + self._exe._rng_counter))
+        self._exe._rng_counter += 1
+
+        # BCastParamsToGPUs parity: place state per its sharding once;
+        # jit keeps the placement on subsequent steps.
+        state_dev = {
+            n: (v if isinstance(v, jax.Array)
+                else jax.device_put(v, self._state_sharding(n)))
+            for n, v in state.items()}
+        data_sh = self._data_sharding()
+        feeds_dev = {k: jax.device_put(v, repl if k in lod_keys else data_sh)
+                     for k, v in feed_arrays.items()}
+
+        fetches, new_state = entry(state_dev, feeds_dev, rng_key)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [as_numpy(v) for v in fetches]
+        return list(fetches)

@@ -1,0 +1,95 @@
+"""Device mesh + sharding annotations.
+
+The mesh axes follow the scaling-book convention: ``dp`` (data), ``tp``
+(tensor/model), ``pp`` (pipeline), ``sp`` (sequence/context), ``ep``
+(expert). Any subset may be present; axis size 1 is free.
+"""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_DEFAULT_MESH = None
+
+
+def make_mesh(axis_sizes=None, devices=None):
+    """Build a Mesh. axis_sizes: dict like {"dp": 4, "tp": 2} (ordered).
+    Defaults to all local devices on one dp axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_sizes:
+        axis_sizes = {"dp": len(devices)}
+    names = tuple(axis_sizes)
+    sizes = tuple(int(axis_sizes[n]) for n in names)
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(
+            "mesh needs %d devices but only %d available" % (need,
+                                                             len(devices)))
+    arr = np.array(devices[:need]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def set_default_mesh(mesh):
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+    return mesh
+
+
+def default_mesh():
+    return _DEFAULT_MESH
+
+
+def shard(var, *spec):
+    """Annotate a Program variable (or name) with a PartitionSpec-like
+    tuple, e.g. shard(w, None, "tp") → rows replicated, cols on tp.
+    The ParallelExecutor places matching state arrays with this sharding;
+    XLA GSPMD propagates through the computation (tensor parallelism)."""
+    from ..core.program import Variable, default_main_program
+    name = var.name if isinstance(var, Variable) else str(var)
+    prog = (var.block.program if isinstance(var, Variable)
+            else default_main_program())
+    prog._sharding_hints[name] = tuple(spec)
+    return var
+
+
+def sharding_hint(program, name):
+    return program._sharding_hints.get(name)
+
+
+def spec_to_named_sharding(mesh, spec):
+    if spec is None:
+        return NamedSharding(mesh, PartitionSpec())
+    cleaned = []
+    for s in spec:
+        if s is None or s in mesh.axis_names:
+            cleaned.append(s)
+        else:
+            cleaned.append(None)   # axis not in this mesh → replicate dim
+    return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+
+class DistributedStrategy:
+    """Knob container (reference BuildStrategy/ExecutionStrategy parity +
+    the TPU axes)."""
+
+    def __init__(self, dp=None, tp=1, pp=1, sp=1, ep=1,
+                 use_bf16_compute=False, gradient_accumulation_steps=1):
+        self.dp = dp
+        self.tp = tp
+        self.pp = pp
+        self.sp = sp
+        self.ep = ep
+        self.use_bf16_compute = use_bf16_compute
+        self.gradient_accumulation_steps = gradient_accumulation_steps
+
+    def build_mesh(self, devices=None):
+        devices = list(devices if devices is not None else jax.devices())
+        total = len(devices)
+        fixed = self.tp * self.pp * self.sp * self.ep
+        dp = self.dp if self.dp else max(1, total // fixed)
+        sizes = {}
+        for name, size in (("dp", dp), ("pp", self.pp), ("sp", self.sp),
+                           ("ep", self.ep), ("tp", self.tp)):
+            if size and size > 1 or name == "dp":
+                sizes[name] = size
+        return make_mesh(sizes, devices)

@@ -1,0 +1,63 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+Beyond the 2018 reference (SURVEY.md §2.7: EP absent; the closest analog is
+the distributed sparse lookup table). GShard-style design: top-k gating with
+capacity, dispatch/combine as einsums against a one-hot dispatch tensor, and
+expert weights stacked [E, ...] sharded on ``ep`` — XLA GSPMD turns the
+dispatch einsum into the all-to-all over ICI, no manual comm code.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_gating(logits, capacity, rng=None, noise_std=0.0):
+    """logits [T, E] → (dispatch [T, E, C] one-hot, combine [T, E, C],
+    aux_loss). Tokens beyond an expert's capacity are dropped (standard
+    Switch-transformer behavior)."""
+    t, e = logits.shape
+    if noise_std and rng is not None:
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                  # [T]
+    expert_mask = jax.nn.one_hot(expert_idx, e)              # [T, E]
+    # position of each token within its expert's queue
+    pos_in_expert = (jnp.cumsum(expert_mask, axis=0) - 1.0) * expert_mask
+    keep = (pos_in_expert < capacity) * expert_mask          # [T, E]
+    pos = jnp.sum(pos_in_expert * keep, axis=-1)             # [T]
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity)  # [T, C]
+    dispatch = keep[:, :, None] * pos_oh[:, None, :]         # [T, E, C]
+    gate_prob = jnp.sum(probs * expert_mask, axis=-1)        # [T]
+    combine = dispatch * gate_prob[:, None, None]
+    # load-balancing aux loss (GShard eq. 4 / Switch aux)
+    density = jnp.mean(expert_mask, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * (e ** 2) / e
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, gate_w, w_up, w_down, capacity_factor=1.25, rng=None,
+            mesh=None, ep_axis="ep"):
+    """Switch-style MoE FFN.
+
+    x       [T, D] tokens
+    gate_w  [D, E]
+    w_up    [E, D, H] stacked expert weights (shard on ep)
+    w_down  [E, H, D]
+    Returns ([T, D], aux_loss).
+    """
+    t, d = x.shape
+    e = gate_w.shape[1]
+    capacity = max(1, int(capacity_factor * t / e))
+    logits = x @ gate_w
+    dispatch, combine, aux = top1_gating(logits, capacity, rng)
+    # dispatch tokens to experts: [E, C, D]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    if mesh is not None and ep_axis in mesh.axis_names:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(ep_axis)))
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, w_up))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out, aux

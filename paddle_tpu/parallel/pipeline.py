@@ -1,0 +1,78 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+Beyond the 2018 reference (SURVEY.md §2.7: PP absent; the closest legacy
+analog is ParallelNeuralNetwork's static layer placement). TPU-native
+design: stage parameters are STACKED on a leading [S, ...] axis sharded on
+``pp`` — every device runs the same stage function on its own parameter
+shard, and activations ride the ICI ring via ``ppermute``. One jitted
+computation, S + M - 1 ticks for M microbatches (the classic GPipe bubble),
+differentiable end-to-end (grads flow through ppermute).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _gpipe_sharded(params, xs, stage_fn, axis_name):
+    """Inside shard_map. params: stage-local pytree (leading [1,...] leaves);
+    xs [M, mb, ...] microbatches (replicated). Returns [M, mb, ...] final-
+    stage outputs (valid on every shard; the last stage's results are
+    broadcast back through the ring)."""
+    s_idx = lax.axis_index(axis_name)
+    n_stage = lax.psum(1, axis_name)
+    m = xs.shape[0]
+    local_params = jax.tree_util.tree_map(lambda p: p[0], params)
+
+    def tick(t, carry):
+        state_in, outputs = carry
+        # stage 0 ingests microbatch t (zeros once drained)
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inject = jnp.where(t < m, xs[mb_idx], jnp.zeros_like(xs[0]))
+        inp = jnp.where(s_idx == 0, inject, state_in)
+        out = stage_fn(local_params, inp)
+        # last stage completed microbatch t-(S-1)
+        out_mb = t - (n_stage - 1)
+        write = jnp.logical_and(s_idx == n_stage - 1, out_mb >= 0)
+        upd = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, out, outputs[jnp.clip(out_mb, 0, m - 1)]),
+            jnp.clip(out_mb, 0, m - 1), 0)
+        outputs = jnp.where(write, upd, outputs)
+        state_next = lax.ppermute(
+            out, axis_name,
+            [(j, (j + 1) % n_stage) for j in range(n_stage)])
+        return state_next, outputs
+
+    state0 = jnp.zeros_like(xs[0])
+    outputs0 = jnp.zeros_like(xs)
+    _, outputs = lax.fori_loop(0, n_stage + m - 1, tick, (state0, outputs0))
+    # broadcast final-stage outputs to every shard so out_specs can be
+    # replicated: non-final stages hold zeros, so a psum is an exact
+    # broadcast (and stays differentiable)
+    return lax.psum(outputs, axis_name)
+
+
+def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp"):
+    """Run ``stage_fn(params_i, x)`` as an S-stage pipeline.
+
+    stacked_params: pytree whose leaves have leading dim S (= mesh[axis]);
+    microbatches:   [M, mb, ...] array of M microbatches.
+    Returns [M, mb, ...] outputs of the final stage.
+    """
+    s = mesh.shape[axis_name]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != s:
+            raise ValueError(
+                "stacked_params leading dim %d != %d pipeline stages"
+                % (leaf.shape[0], s))
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    fn = shard_map(
+        functools.partial(_gpipe_sharded, stage_fn=stage_fn,
+                          axis_name=axis_name),
+        mesh=mesh, in_specs=(pspec, P()), out_specs=P(), check_rep=False)
+    return fn(stacked_params, microbatches)

@@ -4,11 +4,20 @@ the driver's dryrun_multichip uses the same mechanism)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# override, don't setdefault: the driver environment pre-sets
+# JAX_PLATFORMS=axon (the one real TPU chip), and the axon plugin re-prepends
+# itself to jax_platforms even over an env override — so force the config
+# AFTER import too. The suite must run on the virtual 8-device CPU platform
+# per the multi-chip test strategy.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,289 @@
+"""Control-flow + RNN tests: recurrent/scan, while, lstm/gru, DynamicRNN."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_static_rnn_accumulator_matches_numpy():
+    # time-major input [T, B, D]; step: h = h_prev * decay + x_t @ I
+    x = fluid.layers.data("x", [3, 4], append_batch_size=False)
+    x3 = fluid.layers.reshape(x, [5, 3, 4])   # dummy reshape to [T,B,D]
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x3)
+        h_prev = rnn.memory(shape=[-1, 4], batch_ref=x_t, init_value=0.0)
+        h = fluid.layers.scale(h_prev, 0.5) + x_t
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(0).rand(15, 4).astype(np.float32)
+    got, = exe.run(feed={"x": xv}, fetch_list=[out])
+    xs = xv.reshape(5, 3, 4)
+    h = np.zeros((3, 4), np.float32)
+    want = []
+    for t in range(5):
+        h = h * 0.5 + xs[t]
+        want.append(h.copy())
+    np.testing.assert_allclose(got, np.stack(want), rtol=1e-5)
+
+
+def test_static_rnn_trains():
+    x = fluid.layers.data("x", [6, 8])          # [B, T, D] batch-major
+    label = fluid.layers.data("label", [1], dtype="int64")
+    xt = fluid.layers.transpose(x, perm=[1, 0, 2])   # [T, B, D]
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(xt)
+        h_prev = rnn.memory(shape=[-1, 16], batch_ref=x_t, init_value=0.0)
+        h = fluid.layers.fc(fluid.layers.concat([x_t, h_prev], axis=1), 16,
+                            act="tanh")
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    outs = rnn()
+    last = fluid.layers.slice(outs, axes=[0], starts=[5], ends=[6]) \
+        if hasattr(fluid.layers, "slice") else outs
+    last = fluid.layers.reshape(last, [-1, 16])
+    pred = fluid.layers.fc(last, 2, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 6, 8).astype(np.float32)
+    yv = np.array([[0], [1], [0], [1]], np.int64)
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < 0.2, losses[-1]
+
+
+def test_dynamic_lstm_forward_matches_numpy():
+    hidden = 4
+    seqs = [3, 5]
+    total = sum(seqs)
+    rng = np.random.RandomState(1)
+    xproj = rng.randn(total, 4 * hidden).astype(np.float32) * 0.5
+    t = fluid.create_lod_tensor(xproj, [seqs])
+    x = fluid.layers.data("x", [4 * hidden], lod_level=1)
+    h, c = fluid.layers.dynamic_lstm(x, size=4 * hidden,
+                                     use_peepholes=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got_h, = exe.run(feed={"x": t}, fetch_list=[h])
+
+    prog = fluid.default_main_program()
+    lstm_op = [o for o in prog.global_block().ops if o.type == "lstm"][0]
+    w = np.asarray(fluid.global_scope().find_var(lstm_op.input("Weight")[0]))
+    b = np.asarray(fluid.global_scope().find_var(lstm_op.input("Bias")[0]))
+
+    def run_seq(xs):
+        hh = np.zeros(hidden, np.float32)
+        cc = np.zeros(hidden, np.float32)
+        outs = []
+        for xt in xs:
+            g = xt + hh @ w + b[0, :4 * hidden]
+            i, f, cg, o = np.split(g, 4)
+            i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+            cc = f * cc + i * np.tanh(cg)
+            hh = o * np.tanh(cc)
+            outs.append(hh.copy())
+        return np.stack(outs)
+
+    want = np.concatenate([run_seq(xproj[:3]), run_seq(xproj[3:])])
+    np.testing.assert_allclose(got_h, want, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_gru_forward_matches_numpy():
+    hidden = 3
+    seqs = [2, 4]
+    rng = np.random.RandomState(2)
+    xproj = rng.randn(sum(seqs), 3 * hidden).astype(np.float32) * 0.5
+    t = fluid.create_lod_tensor(xproj, [seqs])
+    x = fluid.layers.data("x", [3 * hidden], lod_level=1)
+    h = fluid.layers.dynamic_gru(x, size=hidden)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got, = exe.run(feed={"x": t}, fetch_list=[h])
+
+    prog = fluid.default_main_program()
+    gru_op = [o for o in prog.global_block().ops if o.type == "gru"][0]
+    w = np.asarray(fluid.global_scope().find_var(gru_op.input("Weight")[0]))
+    b = np.asarray(fluid.global_scope().find_var(gru_op.input("Bias")[0]))
+
+    def run_seq(xs):
+        hh = np.zeros(hidden, np.float32)
+        outs = []
+        for xt in xs:
+            xu, xr, xc = np.split(xt, 3)
+            gh = hh @ w[:, :2 * hidden]
+            u = _sigmoid(xu + gh[:hidden] + b[0, :hidden])
+            r = _sigmoid(xr + gh[hidden:] + b[0, hidden:2 * hidden])
+            c = np.tanh(xc + (r * hh) @ w[:, 2 * hidden:] +
+                        b[0, 2 * hidden:])
+            hh = (1 - u) * hh + u * c
+            outs.append(hh.copy())
+        return np.stack(outs)
+
+    want = np.concatenate([run_seq(xproj[:2]), run_seq(xproj[2:])])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_reverse_lstm_unequal_lengths_matches_numpy():
+    # regression: end-padded layout means real steps are t < len in BOTH
+    # scan directions; the reverse mask must not select padding
+    hidden = 4
+    seqs = [3, 5]
+    rng = np.random.RandomState(7)
+    xproj = rng.randn(sum(seqs), 4 * hidden).astype(np.float32) * 0.5
+    t = fluid.create_lod_tensor(xproj, [seqs])
+    x = fluid.layers.data("x", [4 * hidden], lod_level=1)
+    h, c = fluid.layers.dynamic_lstm(x, size=4 * hidden,
+                                     use_peepholes=False, is_reverse=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got_h, = exe.run(feed={"x": t}, fetch_list=[h])
+    prog = fluid.default_main_program()
+    lstm_op = [o for o in prog.global_block().ops if o.type == "lstm"][0]
+    w = np.asarray(fluid.global_scope().find_var(lstm_op.input("Weight")[0]))
+    b = np.asarray(fluid.global_scope().find_var(lstm_op.input("Bias")[0]))
+
+    def run_seq_rev(xs):
+        hh = np.zeros(hidden, np.float32)
+        cc = np.zeros(hidden, np.float32)
+        outs = []
+        for xt in xs[::-1]:
+            g = xt + hh @ w + b[0, :4 * hidden]
+            i, f, cg, o = np.split(g, 4)
+            i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+            cc = f * cc + i * np.tanh(cg)
+            hh = o * np.tanh(cc)
+            outs.append(hh.copy())
+        return np.stack(outs[::-1])
+
+    want = np.concatenate([run_seq_rev(xproj[:3]), run_seq_rev(xproj[3:])])
+    assert np.abs(got_h[:3]).max() > 0, "short sequence must not be zeroed"
+    np.testing.assert_allclose(got_h, want, rtol=1e-4, atol=1e-5)
+
+
+def test_stacked_lstm_text_model_trains():
+    # stacked_dynamic_lstm benchmark shape: embedding -> fc -> lstm -> pool
+    words = fluid.layers.data("words", [1], dtype="int64", lod_level=1)
+    label = fluid.layers.data("label", [1], dtype="int64")
+    emb = fluid.layers.embedding(words, size=[50, 16])
+    proj = fluid.layers.fc(emb, 4 * 8)
+    h, c = fluid.layers.dynamic_lstm(proj, size=4 * 8, use_peepholes=False)
+    pooled = fluid.layers.sequence_pool(h, "max")
+    pred = fluid.layers.fc(pooled, 2, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    lens = [5, 3, 6, 4]
+    ids = rng.randint(0, 50, (sum(lens), 1)).astype(np.int64)
+    labels = np.array([[0], [1], [0], [1]], np.int64)
+    t = fluid.create_lod_tensor(ids, [lens])
+    losses = []
+    for _ in range(40):
+        lv, = exe.run(feed={"words": t, "label": labels},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < 0.3, losses[-1]
+
+
+def test_dynamic_rnn_matches_manual_masked_scan():
+    seqs = [3, 1, 2]
+    rng = np.random.RandomState(4)
+    flat = rng.rand(sum(seqs), 5).astype(np.float32)
+    t = fluid.create_lod_tensor(flat, [seqs])
+    x = fluid.layers.data("x", [5], lod_level=1)
+    drnn = fluid.layers.DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(x)
+        h_prev = drnn.memory(shape=[5], value=0.0)
+        h = fluid.layers.scale(h_prev, 0.9) + x_t
+        drnn.update_memory(h_prev, h)
+        drnn.output(h)
+    out = drnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got, = exe.run(feed={"x": t}, fetch_list=[out])
+    # manual per-sequence recurrence, flat output
+    want = []
+    off = 0
+    for ln in seqs:
+        h = np.zeros(5, np.float32)
+        for i in range(ln):
+            h = h * 0.9 + flat[off + i]
+            want.append(h.copy())
+        off += ln
+    np.testing.assert_allclose(got[:sum(seqs)], np.stack(want), rtol=1e-5)
+
+
+def test_while_loop_counts():
+    i = fluid.layers.fill_constant([1], "int64", 0)
+    limit = fluid.layers.fill_constant([1], "int64", 10)
+    acc = fluid.layers.fill_constant([1], "float32", 0.0)
+    cond = fluid.layers.less_than(i, limit)
+    w = fluid.layers.While(cond, loop_vars=[i, acc])
+    with w.block():
+        new_acc = acc + 2.0
+        fluid.layers.assign(new_acc, acc)
+        fluid.layers.increment(i, value=1.0)
+        fluid.layers.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got_i, got_acc = exe.run(feed={}, fetch_list=[i, acc])
+    assert int(np.asarray(got_i)) == 10
+    assert float(np.asarray(got_acc)) == 20.0
+
+
+def test_ifelse_row_merge():
+    x = fluid.layers.data("x", [2])
+    limit = fluid.layers.fill_constant([1], "float32", 0.5)
+    cond = fluid.layers.less_than(x, limit)  # broadcast compare on col 0?
+    # row mask from first feature
+    feat0 = fluid.layers.slice(x, axes=[1], starts=[0], ends=[1]) \
+        if hasattr(fluid.layers, "slice") else x
+    mask = fluid.layers.less_than(feat0, limit)
+    ie = fluid.layers.IfElse(mask)
+    with ie.true_block():
+        xt = ie.input(x)
+        ie.output(fluid.layers.scale(xt, 10.0))
+    with ie.false_block():
+        xf = ie.input(x)
+        ie.output(fluid.layers.scale(xf, -1.0))
+    out, = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[0.1, 1.0], [0.9, 2.0]], np.float32)
+    got, = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, [[1.0, 10.0], [-0.9, -2.0]], rtol=1e-6)
+    del cond
+
+
+def test_switch_scalar_select():
+    step = fluid.layers.fill_constant([1], "float32", 7.0)
+    b1 = fluid.layers.fill_constant([1], "float32", 5.0)
+    lr = fluid.layers.create_global_var(shape=[1], value=0.0,
+                                        dtype="float32",
+                                        persistable=True, name="sw_lr")
+    v_small = fluid.layers.fill_constant([1], "float32", 0.1)
+    v_big = fluid.layers.fill_constant([1], "float32", 0.01)
+    sw = fluid.layers.Switch()
+    with sw.case(fluid.layers.less_than(step, b1)):
+        sw.assign(v_small, lr)
+    with sw.default():
+        sw.assign(v_big, lr)
+    sw.finalize()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got, = exe.run(feed={}, fetch_list=[lr])
+    assert abs(float(np.asarray(got)) - 0.01) < 1e-7
