@@ -1,0 +1,45 @@
+"""Transformer LM benchmark (north star: tokens/sec/chip)."""
+
+import numpy as np
+
+from common import parse_args, get_place, time_loop  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.models import transformer as T  # noqa: E402
+
+
+def main():
+    args = parse_args(
+        "transformer", batch_size=16, iterations=30,
+        extra=lambda p: (
+            p.add_argument("--max_len", type=int, default=256),
+            p.add_argument("--n_layer", type=int, default=4),
+            p.add_argument("--n_head", type=int, default=8),
+            p.add_argument("--d_model", type=int, default=512),
+            p.add_argument("--d_inner", type=int, default=2048),
+            p.add_argument("--vocab", type=int, default=8192)))
+    avg_cost, _ = T.transformer_lm(
+        vocab_size=args.vocab, max_len=args.max_len, n_layer=args.n_layer,
+        n_head=args.n_head, d_model=args.d_model, d_inner=args.d_inner)
+    fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    if args.dtype == "bfloat16":
+        fluid.amp.enable_amp()
+    exe = fluid.Executor(get_place(args))
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    feeds = T.make_lm_batch(rng, args.batch_size, args.max_len, args.vocab)
+    tokens_per_batch = int(feeds["mask"].sum())
+    total = args.iterations + args.skip_batch_num
+    loader = iter(fluid.reader.DeviceLoader(
+        fluid.reader.repeat_feed(feeds, total + 1)))
+
+    def step(i):
+        loss, = exe.run(feed=next(loader), fetch_list=[avg_cost])
+        float(np.asarray(loss))  # sync
+
+    return time_loop(step, args, tokens_per_batch, "tokens")
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,7 @@ from . import profiler  # noqa: F401
 from .transpiler import (  # noqa: F401
     InferenceTranspiler, memory_optimize, release_memory,
 )
+from . import amp  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import ParallelExecutor  # noqa: F401
 

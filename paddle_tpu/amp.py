@@ -1,0 +1,42 @@
+"""Automatic mixed precision (bf16 compute, fp32 accumulate/state).
+
+The reference era used fp16 kernels selected by OpKernelType
+(data_type_transform.cc fp16↔fp32); the TPU-native equivalent is bf16 on
+the MXU: matmul/conv INPUTS are cast to bfloat16 while accumulation stays
+fp32 (preferred_element_type) and all state (params, optimizer moments,
+batch-norm stats) remains fp32. Enable per-process with ``enable_amp()`` or
+scoped with ``amp_guard()``; the matmul/conv lowerings consult this flag.
+"""
+
+import contextlib
+
+_AMP = {"enabled": False}
+
+
+def enable_amp(flag=True):
+    _AMP["enabled"] = bool(flag)
+
+
+def amp_enabled():
+    return _AMP["enabled"]
+
+
+@contextlib.contextmanager
+def amp_guard(enable=True):
+    old = _AMP["enabled"]
+    _AMP["enabled"] = bool(enable)
+    try:
+        yield
+    finally:
+        _AMP["enabled"] = old
+
+
+def maybe_bf16(*arrays):
+    """Cast fp32 arrays to bf16 when AMP is on (inputs to MXU ops)."""
+    import jax.numpy as jnp
+    if not _AMP["enabled"]:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(jnp.bfloat16)
+                if a is not None and a.dtype == jnp.float32 else a
+                for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -85,7 +85,12 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list)
 
         # Normalize feeds to arrays; remember LoD for LoDTensor feeds.
+        # static_info carries trace-time constants derived host-side from
+        # the feed — the per-feed BUCKETED max sequence length (next power
+        # of two), which bounds in-graph padding at ~Tmax instead of the
+        # total token count (the shape-key bucketing of SURVEY.md §7).
         feed_arrays, feed_lods = {}, {}
+        static_info = {}
         for k, v in feed.items():
             if isinstance(v, LoDTensor):
                 feed_arrays[k] = v.data
@@ -93,6 +98,8 @@ class Executor:
                     # sequence ops consume per-sequence LENGTHS (not offsets)
                     lengths = v.recursive_sequence_lengths()[-1]
                     feed_lods[k + "@LOD"] = np.asarray(lengths, np.int32)
+                    mx = max(1, int(max(lengths, default=1)))
+                    static_info[k + "@MAXLEN"] = 1 << (mx - 1).bit_length()
             else:
                 feed_arrays[k] = np.asarray(v) if not isinstance(v, jax.Array) else v
         feed_arrays.update(feed_lods)
@@ -105,13 +112,16 @@ class Executor:
         state_keys = tuple(sorted(state))
 
         # NB: the Program object itself is part of the key (kept alive by the
-        # cache) so id-reuse after GC can never alias two programs.
+        # cache) so id-reuse after GC can never alias two programs. The AMP
+        # flag changes lowering, so it is part of the key too.
+        from ..amp import amp_enabled
         key = (program, program._version, _feed_signature(feed_arrays),
-               fetch_names, state_keys)
+               fetch_names, state_keys, amp_enabled(),
+               tuple(sorted(static_info.items())))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             fn = self._build(program, tuple(sorted(feed_arrays)), fetch_names,
-                             state_keys)
+                             state_keys, static_info)
             entry = jax.jit(fn, donate_argnums=(0,))
             if use_program_cache:
                 self._cache[key] = entry
@@ -137,8 +147,10 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
-    def _build(self, program, feed_names, fetch_names, state_keys):
+    def _build(self, program, feed_names, fetch_names, state_keys,
+               static_info=None):
         """Build the pure step function for one (program, signature)."""
+        static_info = static_info or {}
         block = program.global_block()
         ops = list(block.ops)
         persistable_names = {v.name for v in block.vars.values()
@@ -161,7 +173,8 @@ class Executor:
             env.update(state)
             env.update(feeds)
             ctx = registry.LowerContext(env, rng_fn, executor=self,
-                                        block=block)
+                                        block=block,
+                                        static_info=static_info)
             if bwd_idx is None:
                 for op in ops:
                     _lower_op(ctx, op)
@@ -197,7 +210,8 @@ class Executor:
             env.update(params)
             fctx = registry.LowerContext(env, ctx._rng_fn,
                                          is_test=ctx.is_test,
-                                         executor=ctx.executor, block=block)
+                                         executor=ctx.executor, block=block,
+                                         static_info=ctx.static_info)
             for op in ops[:bwd_idx]:
                 _lower_op(fctx, op)
             # scalar objective: mean-reduce each target (loss is already
@@ -252,15 +266,17 @@ def _propagate_lod(ctx, op):
     ``embedding → sequence_pool`` see per-sequence boundaries."""
     in_lod = None
     lead = None
+    src = None
     for name in op.input_names:
         lod = ctx.env.get(name + "@LOD")
         if lod is not None:
             val = ctx.env.get(name)
             if val is not None and getattr(val, "ndim", 0) >= 1:
-                in_lod, lead = lod, val.shape[0]
+                in_lod, lead, src = lod, val.shape[0], name
                 break
     if in_lod is None:
         return
+    maxlen = ctx.static_info.get(src + "@MAXLEN")
     for name in op.output_names:
         if name + "@LOD" in ctx.env:
             continue  # lowering set it explicitly
@@ -268,6 +284,8 @@ def _propagate_lod(ctx, op):
         if val is not None and getattr(val, "ndim", 0) >= 1 \
                 and val.shape[0] == lead:
             ctx.env[name + "@LOD"] = in_lod
+            if maxlen is not None:
+                ctx.static_info.setdefault(name + "@MAXLEN", maxlen)
 
 
 def _lower_feed_fetch(ctx, op):
@@ -289,4 +307,7 @@ def _fetch_from_env(env, name):
         raise KeyError(
             "fetch var %r was not produced by the program; "
             "available: %s..." % (name, sorted(env)[:20]))
-    return env[name]
+    val = env[name]
+    if isinstance(val, list):     # LoDTensorArray — stack lazily on fetch
+        val = jnp.stack(val)
+    return val

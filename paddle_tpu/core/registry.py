@@ -52,13 +52,16 @@ class LowerContext:
     """
 
     def __init__(self, env, rng_fn, is_test=False, executor=None, block=None,
-                 mesh=None):
+                 mesh=None, static_info=None):
         self.env = env
         self._rng_fn = rng_fn      # () -> fresh jax PRNG key
         self.is_test = is_test
         self.executor = executor
         self.block = block
         self.mesh = mesh
+        # trace-time constants derived from the feed (e.g. "<name>@MAXLEN"
+        # bucketed max sequence length); part of the compile-cache key
+        self.static_info = static_info or {}
 
     # -- value access --------------------------------------------------------
     def get(self, name):

@@ -130,6 +130,20 @@ class BilinearInitializer(Initializer):
                    "values": weight})
 
 
+class NumpyArrayInitializer(Initializer):
+    """Initialize a var to an exact numpy array (fluid NumpyArrayInitializer
+    parity); used e.g. for sinusoid position-encoding tables."""
+
+    def __init__(self, value):
+        self._value = np.asarray(value)
+
+    def __call__(self, var, block):
+        block.append_op(
+            type="assign_value", outputs={"Out": var},
+            attrs={"shape": list(self._value.shape), "dtype": var.dtype,
+                   "values": self._value.astype(np.float32)})
+
+
 # Aliases matching fluid.initializer public names
 Constant = ConstantInitializer
 Uniform = UniformInitializer

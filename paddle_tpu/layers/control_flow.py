@@ -26,22 +26,7 @@ __all__ = ["While", "StaticRNN", "DynamicRNN", "IfElse", "Switch",
 from .tensor import increment  # noqa: F401  (single implementation)
 
 
-def less_than(x, y, cond=None):
-    helper = LayerHelper("less_than")
-    if cond is None:
-        cond = helper.create_variable_for_type_inference("bool")
-    helper.append_op(type="less_than", inputs={"X": [x], "Y": [y]},
-                     outputs={"Out": [cond]})
-    return cond
-
-
-def equal(x, y, cond=None):
-    helper = LayerHelper("equal")
-    if cond is None:
-        cond = helper.create_variable_for_type_inference("bool")
-    helper.append_op(type="equal", inputs={"X": [x], "Y": [y]},
-                     outputs={"Out": [cond]})
-    return cond
+from .ops import equal, less_than  # noqa: F401  (single implementation)
 
 
 def create_array(dtype):
@@ -221,8 +206,11 @@ class StaticRNN:
                 if inner is batch_ref:
                     ref, ref_dim = outer, 1
                     break
+            # carry dtype must match the updated state's dtype (lax.scan
+            # rejects carry dtype changes), so follow the reference input
+            mem_dtype = getattr(batch_ref, "dtype", "float32") or "float32"
             init = parent.create_var(
-                name=unique_name.generate("rnn_mem_boot"), dtype="float32",
+                name=unique_name.generate("rnn_mem_boot"), dtype=mem_dtype,
                 shape=tuple(shape))
             parent.append_op(
                 type="fill_constant_batch_size_like",
@@ -231,7 +219,7 @@ class StaticRNN:
                                             else shape),
                        "value": float(init_value
                                       if init_value is not None else value),
-                       "dtype": "float32",
+                       "dtype": mem_dtype,
                        "input_dim_idx": ref_dim,
                        "output_dim_idx": init_batch_dim_idx})
         inner = self._sub_block.create_var(

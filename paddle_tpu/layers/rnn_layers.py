@@ -145,5 +145,6 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         outputs={"Gate": [gate], "ResetHiddenPrev": [reset_hidden_prev],
                  "Hidden": [updated_hidden]},
         attrs={"activation": activation,
-               "gate_activation": gate_activation})
+               "gate_activation": gate_activation,
+               "origin_mode": origin_mode})
     return updated_hidden, reset_hidden_prev, gate

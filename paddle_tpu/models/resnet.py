@@ -75,10 +75,14 @@ def resnet_imagenet(input, depth=50, num_classes=1000):
 
 
 def build_train_net(model="resnet_cifar10", depth=None, image_shape=(3, 32, 32),
-                    num_classes=10, learning_rate=0.01):
-    """Returns (image, label, avg_cost, accuracy)."""
-    image = fluid.layers.data("data", list(image_shape))
-    label = fluid.layers.data("label", [1], dtype="int64")
+                    num_classes=10, learning_rate=0.01, image=None,
+                    label=None):
+    """Returns (image, label, avg_cost, accuracy). Pass pre-built image/
+    label vars (e.g. in-graph synthetic data) to skip the feed layers."""
+    if image is None:
+        image = fluid.layers.data("data", list(image_shape))
+    if label is None:
+        label = fluid.layers.data("label", [1], dtype="int64")
     if model == "resnet_cifar10":
         predict = resnet_cifar10(image, depth or 32, num_classes)
     else:

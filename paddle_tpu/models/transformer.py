@@ -1,0 +1,240 @@
+"""Transformer (encoder-decoder MT + decoder-only LM).
+
+Reference parity: tests/unittests/transformer_model.py:41 (multi_head_
+attention, positionwise FFN, pre/post-process wrappers, encoder/decoder,
+sinusoid position encoding) and nets.py:168 scaled_dot_product_attention.
+
+TPU-first: dense padded [B, T] batches with in-graph masks (no LoD), all
+attention math as batched matmuls on the MXU; bf16-friendly. This is the
+flagship perf model (BASELINE.json north star: Transformer tokens/sec/chip).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def position_encoding_init(n_position, d_model):
+    """Sinusoid position encoding table [n_position, d_model]."""
+    pos = np.arange(n_position)[:, None].astype(np.float64)
+    dim = np.arange(d_model)[None, :].astype(np.float64)
+    angle = pos / np.power(10000, 2 * (dim // 2) / d_model)
+    enc = np.zeros((n_position, d_model), np.float32)
+    enc[:, 0::2] = np.sin(angle[:, 0::2])
+    enc[:, 1::2] = np.cos(angle[:, 1::2])
+    return enc
+
+
+def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
+                         d_model, n_head=1, dropout_rate=0.0):
+    """queries/keys/values: [B, T, D]; attn_bias: [B, n_head, Tq, Tk] addend
+    (−inf at masked positions) or None."""
+    q = layers.fc(queries, d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+    k = layers.fc(keys, d_key * n_head, num_flatten_dims=2, bias_attr=False)
+    v = layers.fc(values, d_value * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+
+    def split_heads(x, d):
+        b, t = x.shape[0], x.shape[1]
+        x = layers.reshape(x, [b, t, n_head, d])
+        return layers.transpose(x, perm=[0, 2, 1, 3])     # [B, H, T, d]
+
+    q = split_heads(q, d_key)
+    k = split_heads(k, d_key)
+    v = split_heads(v, d_value)
+
+    product = layers.matmul(layers.scale(q, d_key ** -0.5), k,
+                            transpose_y=True)             # [B, H, Tq, Tk]
+    if attn_bias is not None:
+        product = layers.elementwise_add(product, attn_bias)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)                       # [B, H, Tq, dv]
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    b, t = ctx.shape[0], ctx.shape[1]
+    ctx = layers.reshape(ctx, [b, t, n_head * d_value])
+    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def positionwise_feed_forward(x, d_inner, d_model):
+    hidden = layers.fc(x, d_inner, num_flatten_dims=2, act="relu")
+    return layers.fc(hidden, d_model, num_flatten_dims=2)
+
+
+def pre_post_process_layer(prev, out, process_cmd, dropout_rate=0.0):
+    """'a' residual-add, 'n' layernorm, 'd' dropout (transformer_model.py
+    pre_post_process_layer parity)."""
+    for cmd in process_cmd:
+        if cmd == "a":
+            out = layers.elementwise_add(out, prev) if prev is not None \
+                else out
+        elif cmd == "n":
+            out = layers.layer_norm(out, begin_norm_axis=len(out.shape) - 1)
+        elif cmd == "d":
+            if dropout_rate:
+                out = layers.dropout(out, dropout_prob=dropout_rate)
+    return out
+
+
+def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
+                  dropout_rate=0.0):
+    attn = multi_head_attention(x, x, x, attn_bias, d_key, d_value, d_model,
+                                n_head, dropout_rate)
+    attn_out = pre_post_process_layer(x, attn, "dan", dropout_rate)
+    ffn = positionwise_feed_forward(attn_out, d_inner, d_model)
+    return pre_post_process_layer(attn_out, ffn, "dan", dropout_rate)
+
+
+def decoder_layer(x, enc_output, slf_attn_bias, dec_enc_attn_bias, n_head,
+                  d_key, d_value, d_model, d_inner, dropout_rate=0.0):
+    slf = multi_head_attention(x, x, x, slf_attn_bias, d_key, d_value,
+                               d_model, n_head, dropout_rate)
+    slf_out = pre_post_process_layer(x, slf, "dan", dropout_rate)
+    if enc_output is not None:
+        cross = multi_head_attention(slf_out, enc_output, enc_output,
+                                     dec_enc_attn_bias, d_key, d_value,
+                                     d_model, n_head, dropout_rate)
+        cross_out = pre_post_process_layer(slf_out, cross, "dan",
+                                           dropout_rate)
+    else:
+        cross_out = slf_out
+    ffn = positionwise_feed_forward(cross_out, d_inner, d_model)
+    return pre_post_process_layer(cross_out, ffn, "dan", dropout_rate)
+
+
+def _embed(tokens, vocab_size, d_model, max_len, pos_input, name):
+    word = layers.embedding(
+        tokens, size=[vocab_size, d_model],
+        param_attr=fluid.ParamAttr(
+            name=name + "_word_emb",
+            initializer=fluid.initializer.Normal(0., d_model ** -0.5)))
+    word = layers.scale(word, d_model ** 0.5)
+    pos = layers.embedding(
+        pos_input, size=[max_len, d_model],
+        param_attr=fluid.ParamAttr(
+            name=name + "_pos_emb", trainable=False,
+            initializer=fluid.initializer.NumpyArrayInitializer(
+                position_encoding_init(max_len, d_model))))
+    return layers.elementwise_add(word, pos)
+
+
+def make_attn_bias(mask_2d, n_head, causal=False, seq_len=None):
+    """mask_2d: [B, T] 1/0 validity → additive bias [B, H, T, T]."""
+    b, t = mask_2d.shape[0], mask_2d.shape[1]
+    key_mask = layers.reshape(mask_2d, [b, 1, 1, t])
+    bias = layers.scale(key_mask, 1e9, bias=-1e9, bias_after_scale=False)
+    # (mask-1)*1e9 : 0 where valid, -1e9 where padding
+    bias = layers.expand(bias, expand_times=[1, n_head, t, 1])
+    if causal:
+        tri = np.triu(np.ones((t, t), np.float32), k=1) * -1e9
+        tri_var = layers.assign(tri.reshape(1, 1, t, t))
+        bias = layers.elementwise_add(bias, tri_var)
+    return bias
+
+
+def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
+                   d_model=512, d_inner=2048, dropout_rate=0.0,
+                   label_smooth_eps=0.0):
+    """Decoder-only LM (flagship bench model). Feeds: src [B,T] int64,
+    pos [B,T] int64, mask [B,T] float32, label [B,T] int64.
+    Returns (avg_cost, logits)."""
+    d_key = d_value = d_model // n_head
+    src = layers.data("src", [max_len], dtype="int64")
+    pos = layers.data("pos", [max_len], dtype="int64")
+    mask = layers.data("mask", [max_len], dtype="float32")
+    label = layers.data("label", [max_len], dtype="int64")
+
+    x = _embed(src, vocab_size, d_model, max_len, pos, "lm")
+    if dropout_rate:
+        x = layers.dropout(x, dropout_prob=dropout_rate)
+    bias = make_attn_bias(mask, n_head, causal=True)
+    for _ in range(n_layer):
+        x = decoder_layer(x, None, bias, None, n_head, d_key, d_value,
+                          d_model, d_inner, dropout_rate)
+    logits = layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False)
+
+    b, t = logits.shape[0], logits.shape[1]
+    flat_logits = layers.reshape(logits, [-1, vocab_size])
+    flat_label = layers.reshape(label, [-1, 1])
+    if label_smooth_eps:
+        smooth = layers.label_smooth(
+            layers.one_hot(flat_label, vocab_size), epsilon=label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(flat_logits, smooth,
+                                                 soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(flat_logits, flat_label)
+    flat_mask = layers.reshape(mask, [-1, 1])
+    masked = layers.elementwise_mul(cost, flat_mask)
+    avg_cost = layers.reduce_sum(masked) / layers.reduce_sum(flat_mask)
+    return avg_cost, logits
+
+
+def transformer(src_vocab_size=4096, trg_vocab_size=4096, max_len=64,
+                n_layer=2, n_head=8, d_model=256, d_inner=1024,
+                dropout_rate=0.0, label_smooth_eps=0.0):
+    """Encoder-decoder MT model (machine_translation benchmark parity).
+    Feeds: src_word, src_pos, src_mask, trg_word, trg_pos, trg_mask,
+    lbl_word — all [B, T]. Returns (avg_cost, predictions)."""
+    d_key = d_value = d_model // n_head
+    src_word = layers.data("src_word", [max_len], dtype="int64")
+    src_pos = layers.data("src_pos", [max_len], dtype="int64")
+    src_mask = layers.data("src_mask", [max_len], dtype="float32")
+    trg_word = layers.data("trg_word", [max_len], dtype="int64")
+    trg_pos = layers.data("trg_pos", [max_len], dtype="int64")
+    trg_mask = layers.data("trg_mask", [max_len], dtype="float32")
+    lbl_word = layers.data("lbl_word", [max_len], dtype="int64")
+
+    enc_in = _embed(src_word, src_vocab_size, d_model, max_len, src_pos,
+                    "src")
+    enc_bias = make_attn_bias(src_mask, n_head)
+    enc = enc_in
+    for _ in range(n_layer):
+        enc = encoder_layer(enc, enc_bias, n_head, d_key, d_value, d_model,
+                            d_inner, dropout_rate)
+
+    dec_in = _embed(trg_word, trg_vocab_size, d_model, max_len, trg_pos,
+                    "trg")
+    slf_bias = make_attn_bias(trg_mask, n_head, causal=True)
+    # cross bias: queries = trg positions, keys = src positions
+    b = src_mask.shape[0]
+    t = max_len
+    key_mask = layers.reshape(src_mask, [b, 1, 1, t])
+    cross_bias = layers.scale(key_mask, 1e9, bias=-1e9,
+                              bias_after_scale=False)
+    cross_bias = layers.expand(cross_bias, expand_times=[1, n_head, t, 1])
+    dec = dec_in
+    for _ in range(n_layer):
+        dec = decoder_layer(dec, enc, slf_bias, cross_bias, n_head, d_key,
+                            d_value, d_model, d_inner, dropout_rate)
+
+    logits = layers.fc(dec, trg_vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+    flat_logits = layers.reshape(logits, [-1, trg_vocab_size])
+    flat_label = layers.reshape(lbl_word, [-1, 1])
+    if label_smooth_eps:
+        smooth = layers.label_smooth(
+            layers.one_hot(flat_label, trg_vocab_size),
+            epsilon=label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(flat_logits, smooth,
+                                                 soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(flat_logits, flat_label)
+    flat_mask = layers.reshape(trg_mask, [-1, 1])
+    masked = layers.elementwise_mul(cost, flat_mask)
+    avg_cost = layers.reduce_sum(masked) / layers.reduce_sum(flat_mask)
+    return avg_cost, logits
+
+
+def make_lm_batch(rng, batch, max_len, vocab_size):
+    """Synthetic LM batch (shifted-token next-token task)."""
+    lens = rng.randint(max_len // 2, max_len + 1, size=batch)
+    src = rng.randint(3, vocab_size, size=(batch, max_len))
+    mask = (np.arange(max_len)[None, :] < lens[:, None]).astype(np.float32)
+    src = (src * mask).astype(np.int64)
+    label = np.roll(src, -1, axis=1)
+    label[:, -1] = 0
+    pos = np.tile(np.arange(max_len, dtype=np.int64), (batch, 1))
+    return {"src": src, "pos": pos, "mask": mask, "label": label}

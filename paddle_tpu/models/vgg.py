@@ -25,9 +25,11 @@ def vgg16_bn_drop(input, num_classes=10):
 
 
 def build_train_net(image_shape=(3, 32, 32), num_classes=10,
-                    learning_rate=1e-3):
-    image = fluid.layers.data("data", list(image_shape))
-    label = fluid.layers.data("label", [1], dtype="int64")
+                    learning_rate=1e-3, image=None, label=None):
+    if image is None:
+        image = fluid.layers.data("data", list(image_shape))
+    if label is None:
+        label = fluid.layers.data("label", [1], dtype="int64")
     predict = vgg16_bn_drop(image, num_classes)
     cost = fluid.layers.cross_entropy(predict, label)
     avg_cost = fluid.layers.mean(cost)

@@ -5,6 +5,8 @@ from . import (  # noqa: F401
     activations,
     control_flow,
     conv,
+    crf_ctc,
+    detection_ops,
     elementwise,
     rnn_ops,
     loss,

@@ -23,7 +23,8 @@ from ..core.registry import register, LowerContext
 def _trace_block(ctx, block, env):
     from ..core.executor import _lower_op
     sctx = LowerContext(env, ctx._rng_fn, is_test=ctx.is_test,
-                        executor=ctx.executor, block=block)
+                        executor=ctx.executor, block=block,
+                        static_info=ctx.static_info)
     for op2 in block.ops:
         _lower_op(sctx, op2)
     return env
@@ -196,7 +197,9 @@ def _write_to_array(ctx, op):
             lst.append(jnp.zeros_like(x))
         lst[idx] = x
     ctx.env[arr_name + "@ARRAY"] = lst
-    ctx.env[arr_name] = jnp.stack(lst)
+    # stacking is deferred to readers/fetch (_fetch_from_env) — stacking on
+    # every write would be O(n^2) in trace size
+    ctx.env[arr_name] = lst
 
 
 @register("read_from_array")
@@ -206,10 +209,9 @@ def _read_from_array(ctx, op):
     lst = ctx.env.get(arr_name + "@ARRAY")
     idx = int(jax.core.concrete_or_error(
         None, i.reshape(()), "read_from_array index must be trace-time known"))
-    if lst is not None:
-        ctx.set_out(op, "Out", lst[idx])
-    else:
-        ctx.set_out(op, "Out", ctx.get(arr_name)[idx])
+    if lst is None:
+        lst = ctx.get(arr_name)
+    ctx.set_out(op, "Out", lst[idx])
 
 
 @register("lod_array_length")

@@ -41,6 +41,9 @@ def _conv_dnums(ndim, layout):
 def _conv_nd(ctx, op, ndim):
     x = ctx.in1(op, "Input")
     w = ctx.in1(op, "Filter")
+    out_dtype = x.dtype
+    from ..amp import maybe_bf16
+    x, w = maybe_bf16(x, w)
     strides = _pair(op.attr("strides", [1] * (ndim - 2)), ndim - 2)
     paddings = _pair(op.attr("paddings", [0] * (ndim - 2)), ndim - 2)
     dilations = _pair(op.attr("dilations", [1] * (ndim - 2)), ndim - 2)
@@ -49,13 +52,19 @@ def _conv_nd(ctx, op, ndim):
     layout = "NHWC" if layout in ("NHWC", "NDHWC") else "NCHW"
     dn = _conv_dnums(ndim, layout)
     pad = [(p, p) for p in paddings]
+    # bf16 path: all-bf16 with pet=None. On TPU the MXU accumulates bf16
+    # dots in fp32 internally regardless of preferred_element_type (pet only
+    # selects the RESULT dtype), and an explicit fp32 pet breaks jax's conv
+    # vjp on bf16 inputs (mixed-dtype transpose conv) — so bf16 training
+    # requires this form; only the final rounding to bf16 differs.
+    pet = None if x.dtype == jnp.bfloat16 else (
+        x.dtype if x.dtype == jnp.float64 else jnp.float32)
     out = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         lhs_dilation=(1,) * (ndim - 2), rhs_dilation=dilations,
         dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=x.dtype if x.dtype == jnp.float64
-        else jnp.float32)
-    ctx.set_out(op, "Output", out.astype(x.dtype))
+        preferred_element_type=pet)
+    ctx.set_out(op, "Output", out.astype(out_dtype))
 
 
 @register("conv2d")
@@ -87,6 +96,9 @@ def _conv_transpose_nd(ctx, op, ndim):
     paddings = _pair(op.attr("paddings", [0] * nsp), nsp)
     dilations = _pair(op.attr("dilations", [1] * nsp), nsp)
     groups = int(op.attr("groups", 1) or 1)
+    out_dtype = x.dtype
+    from ..amp import maybe_bf16
+    x, w = maybe_bf16(x, w)
     # transpose-conv == conv with lhs_dilation=stride, flipped kernel,
     # padding (k-1)*d - p on each side
     sp_axes = tuple(range(2, ndim))
@@ -115,7 +127,7 @@ def _conv_transpose_nd(ctx, op, ndim):
             (0, max(0, int(s) - out.shape[2 + i]))
             for i, s in enumerate(out_size)]
         out = jnp.pad(out, pad)
-    ctx.set_out(op, "Output", out.astype(x.dtype))
+    ctx.set_out(op, "Output", out.astype(out_dtype))
 
 
 @register("conv2d_transpose")

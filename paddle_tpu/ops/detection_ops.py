@@ -1,0 +1,295 @@
+"""Detection ops (SSD pipeline).
+
+Reference parity: operators/{prior_box,box_coder,iou_similarity,
+bipartite_match,target_assign,mine_hard_examples,multiclass_nms,
+detection_map}_op.cc and layers/detection.py.
+
+TPU-first: everything is fixed-shape masked math — NMS keeps a static
+max_detections budget with -1 padding instead of dynamic result counts;
+bipartite match is a fori_loop of argmax eliminations.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+@register("prior_box")
+def _prior_box(ctx, op):
+    """Generate SSD prior boxes for a feature map (prior_box_op.cc).
+    Input: feature map [N,C,H,W] + Image [N,3,IH,IW]."""
+    feat = ctx.in1(op, "Input")
+    img = ctx.in1(op, "Image")
+    min_sizes = [float(s) for s in op.attr("min_sizes", [])]
+    max_sizes = [float(s) for s in op.attr("max_sizes", [])]
+    ratios = [float(r) for r in op.attr("aspect_ratios", [1.0])]
+    flip = op.attr("flip", False)
+    clip = op.attr("clip", False)
+    step_w = float(op.attr("step_w", 0.0))
+    step_h = float(op.attr("step_h", 0.0))
+    offset = float(op.attr("offset", 0.5))
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+
+    ars = [1.0]
+    for r in ratios:
+        if all(abs(r - a) > 1e-6 for a in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+
+    wh = []
+    for k, ms in enumerate(min_sizes):
+        for a in ars:
+            wh.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+            if a == 1.0 and k < len(max_sizes):
+                big = np.sqrt(ms * max_sizes[k])
+                wh.append((big, big))
+    wh = np.asarray(wh, np.float32)          # [P, 2]
+    p = wh.shape[0]
+
+    cx = (np.arange(w) + offset) * sw
+    cy = (np.arange(h) + offset) * sh
+    cxg, cyg = np.meshgrid(cx, cy)           # [H, W]
+    boxes = np.zeros((h, w, p, 4), np.float32)
+    boxes[..., 0] = (cxg[:, :, None] - wh[None, None, :, 0] / 2) / iw
+    boxes[..., 1] = (cyg[:, :, None] - wh[None, None, :, 1] / 2) / ih
+    boxes[..., 2] = (cxg[:, :, None] + wh[None, None, :, 0] / 2) / iw
+    boxes[..., 3] = (cyg[:, :, None] + wh[None, None, :, 1] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          boxes.shape).copy()
+    ctx.set_out(op, "Boxes", jnp.asarray(boxes))
+    ctx.set_out(op, "Variances", jnp.asarray(var))
+
+
+def _iou_matrix(a, b):
+    """a [N,4], b [M,4] → [N,M] IoU (xmin,ymin,xmax,ymax)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    ix0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix1 - ix0, 0) * jnp.maximum(iy1 - iy0, 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("iou_similarity")
+def _iou_similarity(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    ctx.set_out(op, "Out", _iou_matrix(x, y))
+
+
+@register("box_coder")
+def _box_coder(ctx, op):
+    """Encode/decode boxes against priors (box_coder_op.cc)."""
+    prior = ctx.in1(op, "PriorBox")            # [M,4]
+    var = ctx.in1(op, "PriorBoxVar")           # [M,4]
+    tb = ctx.in1(op, "TargetBox")
+    code_type = op.attr("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if var is None:
+        var = jnp.ones_like(prior)
+    if "encode" in code_type:
+        # tb [N,4] → [N,M,4]
+        tw = tb[:, 2] - tb[:, 0]
+        th = tb[:, 3] - tb[:, 1]
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+        ow = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10)) / \
+            var[None, :, 2]
+        oh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10)) / \
+            var[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    else:
+        # decode: tb [N,M,4] deltas (or [M,4])
+        if tb.ndim == 2:
+            tb = tb[None]
+        dcx = tb[..., 0] * var[None, :, 0] * pw[None, :] + pcx[None, :]
+        dcy = tb[..., 1] * var[None, :, 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(tb[..., 2] * var[None, :, 2]) * pw[None, :]
+        dh = jnp.exp(tb[..., 3] * var[None, :, 3]) * ph[None, :]
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2, dcy + dh / 2], axis=-1)
+    ctx.set_out(op, "OutputBox", out)
+
+
+@register("bipartite_match")
+def _bipartite_match(ctx, op):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the globally-largest entry, eliminating its row and column."""
+    dist = ctx.in1(op, "DistMat")            # [N, M] (rows=gt, cols=prior)
+    n, m = dist.shape
+    match_type = op.attr("match_type", "bipartite")
+
+    def body(_, carry):
+        d, row_match, col_match = carry
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        valid = d[i, j] > 0
+        row_match = jnp.where(valid, row_match.at[i].set(j), row_match)
+        col_match = jnp.where(valid, col_match.at[j].set(i), col_match)
+        d = jnp.where(valid, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+        return d, row_match, col_match
+
+    row_match = jnp.full((n,), -1, jnp.int32)
+    col_match = jnp.full((m,), -1, jnp.int32)
+    _, row_match, col_match = lax.fori_loop(
+        0, min(n, m), body, (dist, row_match, col_match))
+
+    if match_type == "per_prediction":
+        thresh = float(op.attr("dist_threshold", 0.5))
+        best_row = jnp.argmax(dist, axis=0)
+        best_val = jnp.max(dist, axis=0)
+        extra = (col_match < 0) & (best_val >= thresh)
+        col_match = jnp.where(extra, best_row.astype(jnp.int32), col_match)
+
+    dist_out = jnp.where(
+        col_match >= 0,
+        dist[jnp.clip(col_match, 0), jnp.arange(m)], 0.0)
+    ctx.set_out(op, "ColToRowMatchIndices", col_match[None, :])
+    ctx.set_out(op, "ColToRowMatchDist", dist_out[None, :])
+
+
+@register("target_assign")
+def _target_assign(ctx, op):
+    """Assign per-prior targets from matched gt (target_assign_op.cc)."""
+    x = ctx.in1(op, "X")                    # [N_gt, K] or [N_gt, 1, K]
+    match = ctx.in1(op, "MatchIndices")     # [1, M]
+    if x.ndim == 3:
+        x = x[:, 0, :]
+    mismatch_value = op.attr("mismatch_value", 0)
+    m = match.shape[-1]
+    idx = jnp.clip(match.reshape(-1), 0, x.shape[0] - 1)
+    out = x[idx]
+    neg = (match.reshape(-1) < 0)[:, None]
+    out = jnp.where(neg, jnp.asarray(mismatch_value, x.dtype), out)
+    wt = jnp.where(neg[:, 0], 0.0, 1.0)
+    ctx.set_out(op, "Out", out[None])
+    ctx.set_out(op, "OutWeight", wt[None, :, None])
+
+
+@register("mine_hard_examples")
+def _mine_hard_examples(ctx, op):
+    """Select hard negatives by loss ranking with neg/pos ratio
+    (mine_hard_examples_op.cc, max_negative mining)."""
+    cls_loss = ctx.in1(op, "ClsLoss")        # [N, M]
+    match = ctx.in1(op, "MatchIndices")      # [N, M]
+    neg_pos_ratio = float(op.attr("neg_pos_ratio", 3.0))
+    n, m = cls_loss.shape
+    is_pos = match >= 0
+    num_pos = jnp.sum(is_pos, axis=1)
+    num_neg = jnp.minimum((num_pos * neg_pos_ratio).astype(jnp.int32),
+                          m - num_pos)
+    loss = jnp.where(is_pos, -jnp.inf, cls_loss)
+    order = jnp.argsort(-loss, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    neg_mask = rank < num_neg[:, None]
+    # NegIndices as a masked [N, M] indicator (static shape; -1 padded list
+    # semantics of the reference become a mask here)
+    neg_idx = jnp.where(neg_mask, jnp.arange(m)[None, :], -1)
+    ctx.set_out(op, "NegIndices", jnp.sort(neg_idx, axis=1)[:, ::-1])
+    ctx.set_out(op, "UpdatedMatchIndices",
+                jnp.where(neg_mask, -1, match))
+
+
+def _nms_single_class(boxes, scores, score_thresh, nms_thresh, top_k):
+    """boxes [M,4], scores [M] → keep mask [M] after greedy NMS."""
+    m = boxes.shape[0]
+    valid = scores > score_thresh
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    iou = _iou_matrix(boxes, boxes)
+
+    def body(i, keep):
+        cand = order[i]
+        ok = valid[cand]
+        # suppressed if high IoU with any already-kept higher-score box
+        sup = jnp.any(keep & (iou[cand] > nms_thresh))
+        keep = keep.at[cand].set(jnp.logical_and(ok, ~sup))
+        return keep
+
+    keep = jnp.zeros((m,), bool)
+    keep = lax.fori_loop(0, m if top_k < 0 else min(m, top_k), body, keep)
+    return keep
+
+
+@register("multiclass_nms")
+def _multiclass_nms(ctx, op):
+    """Per-class NMS + cross-class keep_top_k (multiclass_nms_op.cc).
+    Output: fixed [keep_top_k, 6] rows (label, score, x1,y1,x2,y2),
+    -1-padded — the static-shape analog of the reference's LoD output."""
+    boxes = ctx.in1(op, "BBoxes")            # [N, M, 4]
+    scores = ctx.in1(op, "Scores")           # [N, C, M]
+    score_thresh = float(op.attr("score_threshold", 0.0))
+    nms_thresh = float(op.attr("nms_threshold", 0.3))
+    nms_top_k = int(op.attr("nms_top_k", -1))
+    keep_top_k = int(op.attr("keep_top_k", 100))
+    background = int(op.attr("background_label", 0))
+
+    def per_image(b, s):
+        c, m = s.shape
+        outs = []
+        for cls in range(c):
+            if cls == background:
+                continue
+            keep = _nms_single_class(b, s[cls], score_thresh, nms_thresh,
+                                     nms_top_k)
+            sc = jnp.where(keep, s[cls], -1.0)
+            lbl = jnp.full((m,), cls, jnp.float32)
+            outs.append(jnp.concatenate(
+                [lbl[:, None], sc[:, None], b], axis=1))
+        allr = jnp.concatenate(outs, axis=0)          # [(C-1)*M, 6]
+        k = min(keep_top_k, allr.shape[0])
+        topscore, topidx = lax.top_k(allr[:, 1], k)
+        rows = allr[topidx]
+        rows = jnp.where((rows[:, 1:2] > score_thresh), rows, -1.0)
+        return rows
+
+    out = jax.vmap(per_image)(boxes, scores)
+    ctx.set_out(op, "Out", out)
+
+
+@register("detection_map")
+def _detection_map(ctx, op):
+    """mAP metric op (detection_map_op.cc) — simplified single-batch
+    11-point interpolated AP over the NMS output format above."""
+    det = ctx.in1(op, "DetectRes")          # [K, 6] (label, score, box)
+    gt_label = ctx.in1(op, "Label")         # [G, 6] (label, x1,y1,x2,y2..)
+    overlap_t = float(op.attr("overlap_threshold", 0.5))
+    det_valid = det[:, 1] > 0
+    gt_boxes = gt_label[:, -4:]
+    gt_cls = gt_label[:, 0]
+    iou = _iou_matrix(det[:, 2:6], gt_boxes)
+    same_cls = det[:, 0:1] == gt_cls[None, :]
+    matched = (iou > overlap_t) & same_cls
+    tp = jnp.any(matched, axis=1) & det_valid
+    order = jnp.argsort(-det[:, 1])
+    tp_sorted = tp[order]
+    cum_tp = jnp.cumsum(tp_sorted)
+    total = jnp.arange(1, det.shape[0] + 1)
+    precision = cum_tp / total
+    recall = cum_tp / jnp.maximum(gt_boxes.shape[0], 1)
+    ap = 0.0
+    for r in np.arange(0.0, 1.1, 0.1):
+        p = jnp.max(jnp.where(recall >= r, precision, 0.0))
+        ap = ap + p / 11.0
+    ctx.set_out(op, "MAP", ap.reshape(1))
+    ctx.set_out(op, "AccumPosCount", jnp.asarray([det.shape[0]]))

@@ -19,7 +19,15 @@ from ..core.registry import register
 
 
 def _acc_type(x):
-    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    from ..amp import amp_enabled
+    return jnp.float32 if amp_enabled() else None
+
+
+def _amp_cast(*arrays):
+    from ..amp import maybe_bf16
+    return maybe_bf16(*arrays)
 
 
 def _flatten2d(x, num_col_dims):
@@ -33,12 +41,14 @@ def _flatten2d(x, num_col_dims):
 def _mul(ctx, op):
     x = ctx.in1(op, "X")
     y = ctx.in1(op, "Y")
+    out_dtype = x.dtype
+    x, y = _amp_cast(x, y)
     xn = op.attr("x_num_col_dims", 1)
     yn = op.attr("y_num_col_dims", 1)
     x2, xshape = _flatten2d(x, xn)
     y2 = y.reshape(functools.reduce(lambda a, b: a * b, y.shape[:yn], 1), -1)
     out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
-    out = out.astype(x.dtype)
+    out = out.astype(out_dtype)
     out = out.reshape(xshape[:xn] + y.shape[yn:])
     ctx.set_out(op, "Out", out)
 
@@ -47,12 +57,14 @@ def _mul(ctx, op):
 def _matmul(ctx, op):
     x = ctx.in1(op, "X")
     y = ctx.in1(op, "Y")
+    out_dtype = x.dtype
+    x, y = _amp_cast(x, y)
     if op.attr("transpose_X", False):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if op.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    out = out.astype(x.dtype)
+    out = out.astype(out_dtype)
     alpha = op.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

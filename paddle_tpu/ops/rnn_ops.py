@@ -28,14 +28,18 @@ def _act(name):
 
 
 def _pad_from_lod(ctx, op, slot="Input"):
-    """flat [T,D] + lengths → (padded [B,Tmax,D], lengths, total_T)."""
+    """flat [T,D] + lengths → (padded [B,Tmax,D], lengths, total_T).
+    Tmax comes from the executor's bucketed static_info (next-pow2 of the
+    feed's real max length) so the scan runs ~max(lens) steps, not
+    sum(lens)."""
     x = ctx.in1(op, slot)
-    lens = ctx.maybe_get(op.input(slot)[0] + "@LOD")
+    name = op.input(slot)[0]
+    lens = ctx.maybe_get(name + "@LOD")
     t = x.shape[0]
     if lens is None:
         return x[None], jnp.asarray([t], jnp.int32), t
     n = lens.shape[0]
-    maxlen = t  # static upper bound; masking handles the rest
+    maxlen = min(int(ctx.static_info.get(name + "@MAXLEN", t)), t)
     starts = jnp.cumsum(lens) - lens
     rows = starts[:, None] + jnp.arange(maxlen)[None, :]
     valid = jnp.arange(maxlen)[None, :] < lens[:, None]
@@ -260,7 +264,12 @@ def _gru_unit(ctx, op):
     u = ga(xu + gh[:, :d])
     r = ga(xr + gh[:, d:])
     c = ca(xc + (r * h_prev) @ w[:, 2 * d:])
-    h = u * h_prev + (1 - u) * c
+    # gru_unit_op.h:118: h = u*(c - h_prev) + h_prev = u*c + (1-u)*h_prev;
+    # origin_mode flips the gate like dynamic_gru
+    if op.attr("origin_mode", False):
+        h = u * h_prev + (1 - u) * c
+    else:
+        h = u * c + (1 - u) * h_prev
     ctx.set_out(op, "Gate", jnp.concatenate([u, r, c], axis=-1))
     ctx.set_out(op, "ResetHiddenPrev", r * h_prev)
     ctx.set_out(op, "Hidden", h)

@@ -189,3 +189,7 @@ def batch(reader, batch_size, drop_last=False):
         if b and not drop_last:
             yield b
     return batch_reader
+
+
+from .device_loader import DeviceLoader, repeat_feed  # noqa: F401,E402
+__all__ += ["DeviceLoader", "repeat_feed"]

@@ -1,0 +1,258 @@
+"""Detection, CRF, and CTC op tests vs numpy/torch references."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as fluid
+
+
+def _one_op(op_type, inputs, outputs, attrs, feeds, fetch, lods=None):
+    # isolate each op in its own program so tests can chain _one_op calls
+    main = fluid.Program()
+    fluid.switch_main_program(main)
+    blk = fluid.default_main_program().current_block()
+    in_map = {}
+    for slot, (name, shape, dtype) in inputs.items():
+        v = fluid.layers.data(name, list(shape), dtype=dtype,
+                              append_batch_size=False,
+                              lod_level=1 if (lods and name in lods) else 0)
+        in_map[slot] = [v]
+    out_map = {}
+    for slot, name in outputs.items():
+        out_map[slot] = [blk.create_var(name=name, dtype="float32")]
+    blk.append_op(type=op_type, inputs=in_map, outputs=out_map, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(feed=feeds, fetch_list=fetch)
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    got, = _one_op("iou_similarity",
+                   {"X": ("bx", (2, 4), "float32"),
+                    "Y": ("by", (2, 4), "float32")},
+                   {"Out": "iou_out"}, {},
+                   {"bx": x, "by": y}, ["iou_out"])
+    np.testing.assert_allclose(got[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1], 0.0, atol=1e-6)
+    np.testing.assert_allclose(got[1, 0], 1 / 7, rtol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.sort(rng.rand(5, 4).astype(np.float32), axis=1)
+    var = np.full((5, 4), 0.1, np.float32)
+    target = np.sort(rng.rand(3, 4).astype(np.float32), axis=1)
+    enc, = _one_op("box_coder",
+                   {"PriorBox": ("pb", (5, 4), "float32"),
+                    "PriorBoxVar": ("pbv", (5, 4), "float32"),
+                    "TargetBox": ("tb", (3, 4), "float32")},
+                   {"OutputBox": "enc_out"},
+                   {"code_type": "encode_center_size"},
+                   {"pb": prior, "pbv": var, "tb": target}, ["enc_out"])
+    assert enc.shape == (3, 5, 4)
+    # decode back
+    dec, = _one_op("box_coder",
+                   {"PriorBox": ("pb2", (5, 4), "float32"),
+                    "PriorBoxVar": ("pbv2", (5, 4), "float32"),
+                    "TargetBox": ("tb2", (3, 5, 4), "float32")},
+                   {"OutputBox": "dec_out"},
+                   {"code_type": "decode_center_size"},
+                   {"pb2": prior, "pbv2": var, "tb2": enc}, ["dec_out"])
+    want = np.broadcast_to(target[:, None, :], (3, 5, 4))
+    np.testing.assert_allclose(dec, want, rtol=1e-3, atol=1e-4)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.8, 0.7, 0.2]], np.float32)
+    idx, d = _one_op("bipartite_match",
+                     {"DistMat": ("dm", (2, 3), "float32")},
+                     {"ColToRowMatchIndices": "bm_idx",
+                      "ColToRowMatchDist": "bm_dist"}, {},
+                     {"dm": dist}, ["bm_idx", "bm_dist"])
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7
+    np.testing.assert_array_equal(idx[0], [0, 1, -1])
+    np.testing.assert_allclose(d[0], [0.9, 0.7, 0.0], rtol=1e-6)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes = np.array([[[0, 0, 1, 1], [0, 0, 1.05, 1.05],
+                       [2, 2, 3, 3]]], np.float32)        # [1, 3, 4]
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]   # class 1
+    out, = _one_op("multiclass_nms",
+                   {"BBoxes": ("nb", (1, 3, 4), "float32"),
+                    "Scores": ("ns", (1, 2, 3), "float32")},
+                   {"Out": "nms_out"},
+                   {"score_threshold": 0.1, "nms_threshold": 0.5,
+                    "keep_top_k": 3, "background_label": 0},
+                   {"nb": boxes, "ns": scores}, ["nms_out"])
+    kept = out[0][out[0][:, 1] > 0]
+    assert kept.shape[0] == 2          # overlap suppressed
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], rtol=1e-6)
+
+
+def test_prior_box_counts():
+    blk = fluid.default_main_program().current_block()
+    feat = fluid.layers.data("feat", [8, 4, 4])
+    img = fluid.layers.data("img", [3, 64, 64])
+    boxes = blk.create_var(name="pb_boxes", dtype="float32")
+    var = blk.create_var(name="pb_var", dtype="float32")
+    blk.append_op(type="prior_box",
+                  inputs={"Input": [feat], "Image": [img]},
+                  outputs={"Boxes": [boxes], "Variances": [var]},
+                  attrs={"min_sizes": [10.0], "max_sizes": [20.0],
+                         "aspect_ratios": [2.0], "flip": True,
+                         "clip": True})
+    exe = fluid.Executor(fluid.CPUPlace())
+    b, v = exe.run(feed={"feat": np.zeros((1, 8, 4, 4), np.float32),
+                         "img": np.zeros((1, 3, 64, 64), np.float32)},
+                   fetch_list=[boxes, var])
+    # priors per cell: 1 (ar=1,min) + 1 (ar=1,max) + 2 (ar=2 flip) = 4
+    assert b.shape == (4, 4, 4, 4)
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_linear_chain_crf_matches_brute_force():
+    d, t = 3, 4
+    rng = np.random.RandomState(0)
+    emission = rng.randn(t, d).astype(np.float32)
+    trans = rng.randn(d + 2, d).astype(np.float32) * 0.5
+    label = rng.randint(0, d, (t, 1)).astype(np.int64)
+
+    em = fluid.layers.data("em", [d], lod_level=1)
+    lb = fluid.layers.data("lb", [1], dtype="int64", lod_level=1)
+    tr = fluid.layers.data("tr", [d + 2, d], append_batch_size=False)
+    blk = fluid.default_main_program().current_block()
+    ll = blk.create_var(name="crf_ll", dtype="float32")
+    alpha = blk.create_var(name="crf_alpha", dtype="float32")
+    eexp = blk.create_var(name="crf_eexp", dtype="float32")
+    texp = blk.create_var(name="crf_texp", dtype="float32")
+    blk.append_op(type="linear_chain_crf",
+                  inputs={"Emission": [em], "Label": [lb],
+                          "Transition": [tr]},
+                  outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                           "EmissionExps": [eexp],
+                           "TransitionExps": [texp]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    em_t = fluid.create_lod_tensor(emission, [[t]])
+    lb_t = fluid.create_lod_tensor(label, [[t]])
+    got, = exe.run(feed={"em": em_t, "lb": lb_t, "tr": trans},
+                   fetch_list=[ll])
+
+    # brute force over all d^t paths
+    import itertools
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+
+    def score(path):
+        s = w_start[path[0]] + w_end[path[-1]]
+        s += sum(emission[i, p] for i, p in enumerate(path))
+        s += sum(w[path[i], path[i + 1]] for i in range(t - 1))
+        return s
+
+    scores = [score(p) for p in itertools.product(range(d), repeat=t)]
+    log_z = np.log(np.sum(np.exp(scores)))
+    gold = score(tuple(label.reshape(-1)))
+    want = log_z - gold
+    np.testing.assert_allclose(float(got.reshape(-1)[0]), want, rtol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    d, t = 3, 4
+    rng = np.random.RandomState(1)
+    emission = rng.randn(t, d).astype(np.float32)
+    trans = rng.randn(d + 2, d).astype(np.float32) * 0.5
+    em = fluid.layers.data("em", [d], lod_level=1)
+    tr = fluid.layers.data("tr", [d + 2, d], append_batch_size=False)
+    blk = fluid.default_main_program().current_block()
+    path = blk.create_var(name="vit_path", dtype="int64")
+    blk.append_op(type="crf_decoding",
+                  inputs={"Emission": [em], "Transition": [tr]},
+                  outputs={"ViterbiPath": [path]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    em_t = fluid.create_lod_tensor(emission, [[t]])
+    got, = exe.run(feed={"em": em_t, "tr": trans}, fetch_list=[path])
+
+    import itertools
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+
+    def score(p):
+        s = w_start[p[0]] + w_end[p[-1]]
+        s += sum(emission[i, q] for i, q in enumerate(p))
+        s += sum(w[p[i], p[i + 1]] for i in range(t - 1))
+        return s
+
+    best = max(itertools.product(range(d), repeat=t), key=score)
+    np.testing.assert_array_equal(got.reshape(-1)[:t], list(best))
+
+
+def test_warpctc_matches_torch():
+    b, t, c, l = 2, 6, 5, 2
+    rng = np.random.RandomState(2)
+    logits = rng.randn(b * t, c).astype(np.float32)
+    labels = rng.randint(1, c, (b * l, 1)).astype(np.int64)
+    lg = fluid.layers.data("lg", [c], lod_level=1)
+    lb = fluid.layers.data("lb", [1], dtype="int64", lod_level=1)
+    blk = fluid.default_main_program().current_block()
+    loss = blk.create_var(name="ctc_loss", dtype="float32")
+    grad = blk.create_var(name="ctc_grad", dtype="float32")
+    blk.append_op(type="warpctc",
+                  inputs={"Logits": [lg], "Label": [lb]},
+                  outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+                  attrs={"blank": 0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    lg_t = fluid.create_lod_tensor(logits, [[t, t]])
+    lb_t = fluid.create_lod_tensor(labels, [[l, l]])
+    got, = exe.run(feed={"lg": lg_t, "lb": lb_t}, fetch_list=[loss])
+
+    tl = torch.from_numpy(logits.reshape(b, t, c).transpose(1, 0, 2))
+    tl = torch.log_softmax(tl, dim=-1)
+    want = torch.nn.functional.ctc_loss(
+        tl, torch.from_numpy(labels.reshape(b, l)),
+        torch.full((b,), t, dtype=torch.long),
+        torch.full((b,), l, dtype=torch.long),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got.reshape(-1), want, rtol=1e-4)
+
+
+def test_ctc_align():
+    x = np.array([[0], [1], [1], [0], [2], [2], [0]], np.int32)
+    xv = fluid.layers.data("x", [1], dtype="int32", lod_level=1)
+    blk = fluid.default_main_program().current_block()
+    out = blk.create_var(name="align_out", dtype="int64")
+    blk.append_op(type="ctc_align", inputs={"Input": [xv]},
+                  outputs={"Output": [out]},
+                  attrs={"blank": 0, "merge_repeated": True})
+    exe = fluid.Executor(fluid.CPUPlace())
+    t = fluid.create_lod_tensor(x, [[7]])
+    got, = exe.run(feed={"x": t}, fetch_list=[out])
+    np.testing.assert_array_equal(got.reshape(-1)[:2], [1, 2])
+
+
+def test_chunk_eval_iob():
+    # IOB, 1 type: B=0, I=1, O=2
+    # label:  B I O B I   → chunks (0-1), (3-4)
+    # infer:  B I O B O   → chunks (0-1), (3-3)
+    lab = np.array([[0], [1], [2], [0], [1]], np.int64)
+    inf = np.array([[0], [1], [2], [0], [2]], np.int64)
+    iv = fluid.layers.data("iv", [1], dtype="int64", lod_level=1)
+    lv = fluid.layers.data("lv", [1], dtype="int64", lod_level=1)
+    blk = fluid.default_main_program().current_block()
+    outs = {k: blk.create_var(name="ce_%s" % k, dtype="float32")
+            for k in ["p", "r", "f", "ni", "nl", "nc"]}
+    blk.append_op(type="chunk_eval",
+                  inputs={"Inference": [iv], "Label": [lv]},
+                  outputs={"Precision": [outs["p"]], "Recall": [outs["r"]],
+                           "F1-Score": [outs["f"]],
+                           "NumInferChunks": [outs["ni"]],
+                           "NumLabelChunks": [outs["nl"]],
+                           "NumCorrectChunks": [outs["nc"]]},
+                  attrs={"num_chunk_types": 1, "chunk_scheme": "IOB"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = exe.run(feed={"iv": fluid.create_lod_tensor(inf, [[5]]),
+                        "lv": fluid.create_lod_tensor(lab, [[5]])},
+                  fetch_list=[outs["ni"], outs["nl"], outs["nc"]])
+    ni, nl, nc = [int(np.asarray(g).reshape(-1)[0]) for g in got]
+    assert ni == 2 and nl == 2 and nc == 1
