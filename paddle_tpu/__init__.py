@@ -58,6 +58,9 @@ from .transpiler import (  # noqa: F401
     InferenceTranspiler, memory_optimize, release_memory,
 )
 from . import amp  # noqa: F401
+from . import distributed  # noqa: F401
+from .distributed import DistributeTranspiler  # noqa: F401
+from .core.selected_rows import SelectedRows  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import ParallelExecutor  # noqa: F401
 

@@ -40,8 +40,9 @@ class _FetchEscape(Exception):
 
 def as_numpy(value):
     """Convert a fetched value (jax.Array / LoDTensor / list) to numpy."""
-    if isinstance(value, LoDTensor):
-        return value  # keep lod info; caller can np.asarray it
+    from .selected_rows import SelectedRows
+    if isinstance(value, (LoDTensor, SelectedRows)):
+        return value  # structured values pass through
     if isinstance(value, (list, tuple)):
         return [as_numpy(v) for v in value]
     return np.asarray(value)
@@ -114,6 +115,16 @@ class Executor:
         # NB: the Program object itself is part of the key (kept alive by the
         # cache) so id-reuse after GC can never alias two programs. The AMP
         # flag changes lowering, so it is part of the key too.
+        # Programs containing host (IO) ops — send/recv/listen_and_serv —
+        # run in eager-interpreter mode: each lowering executes immediately
+        # on concrete values, so IO happens for real. This is the
+        # reference's op-by-op interpreter, kept ONLY for the distributed
+        # edge where the reference also left graph land.
+        if any(registry.is_host_op(o.type)
+               for o in program.global_block().ops):
+            return self._run_eager(program, feed_arrays, fetch_names,
+                                   scope, static_info, return_numpy)
+
         from ..amp import amp_enabled
         key = (program, program._version, _feed_signature(feed_arrays),
                fetch_names, state_keys, amp_enabled(),
@@ -145,6 +156,47 @@ class Executor:
         if return_numpy:
             return [as_numpy(v) for v in fetches]
         return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _run_eager(self, program, feed_arrays, fetch_names, scope,
+                   static_info, return_numpy):
+        """Op-by-op eager execution (host-op programs only)."""
+        block = program.global_block()
+        ops = list(block.ops)
+        persistable = {v.name for v in block.vars.values() if v.persistable}
+        env = {n: scope.find_var(n) for n in persistable
+               if scope.find_var(n) is not None}
+        env.update(feed_arrays)
+
+        counter = [0]
+        base_key = jax.random.key(
+            np.uint32(program.random_seed * 1000003 + self._rng_counter))
+        self._rng_counter += 1
+
+        def rng_fn():
+            counter[0] += 1
+            return jax.random.fold_in(base_key, counter[0])
+
+        ctx = registry.LowerContext(env, rng_fn, executor=self, block=block,
+                                    static_info=static_info)
+        bwd_idx = None
+        for i, o in enumerate(ops):
+            if o.type in ("backward_marker", "calc_gradient_marker"):
+                bwd_idx = i
+                break
+        if bwd_idx is None:
+            for o in ops:
+                _lower_op(ctx, o)
+        else:
+            self._lower_with_grad(ctx, ops, bwd_idx, program, block)
+
+        for n in persistable:
+            if n in env:
+                scope.set(n, env[n])
+        fetches = [_fetch_from_env(env, n) for n in fetch_names]
+        if return_numpy:
+            return [as_numpy(v) for v in fetches]
+        return fetches
 
     # ------------------------------------------------------------------
     def _build(self, program, feed_names, fetch_names, state_keys,

@@ -15,24 +15,32 @@ import jax.numpy as jnp
 
 
 class OpInfo:
-    def __init__(self, type, lower, infer_shape=None, stateful_rng=False):
+    def __init__(self, type, lower, infer_shape=None, stateful_rng=False,
+                 host=False):
         self.type = type
         self.lower = lower            # fn(ctx, op) -> None (writes ctx env)
         self.infer_shape = infer_shape
         self.stateful_rng = stateful_rng  # consumes a PRNG key at trace time
+        self.host = host  # does IO → program runs in eager-interpreter mode
 
 
 _REGISTRY = {}
 
 
-def register(type, lower=None, infer_shape=None, stateful_rng=False):
+def register(type, lower=None, infer_shape=None, stateful_rng=False,
+             host=False):
     """Register an op lowering. Usable as decorator or direct call."""
     def deco(fn):
-        _REGISTRY[type] = OpInfo(type, fn, infer_shape, stateful_rng)
+        _REGISTRY[type] = OpInfo(type, fn, infer_shape, stateful_rng, host)
         return fn
     if lower is not None:
         return deco(lower)
     return deco
+
+
+def is_host_op(type):
+    info = _REGISTRY.get(type)
+    return bool(info and info.host)
 
 
 def lookup(type):

@@ -1,0 +1,52 @@
+"""SelectedRows: sparse row-slice value type.
+
+Reference parity: framework/selected_rows.h:27 — {height, rows[], value
+tensor} — the wire/GRADIENT format for embeddings. In the TPU build, dense
+in-XLA gradients stay dense (XLA scatters are fast); SelectedRows is the
+HOST-side format for the sparse distributed tier: prefetched embedding rows
+and sparse gradient pushes to a parameter server across DCN
+(send_recv.proto:59-69 semantics).
+"""
+
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    def __init__(self, rows=None, value=None, height=0):
+        self.rows = np.asarray(rows if rows is not None else [],
+                               np.int64).reshape(-1)
+        self.value = (np.asarray(value) if value is not None
+                      else np.zeros((0, 0), np.float32))
+        self.height = int(height)
+
+    def to_dense(self, width=None):
+        width = width or (self.value.shape[1] if self.value.ndim > 1 else 1)
+        out = np.zeros((self.height, width), self.value.dtype)
+        np.add.at(out, self.rows, self.value)
+        return out
+
+    @staticmethod
+    def from_dense(dense, rows=None):
+        dense = np.asarray(dense)
+        if rows is None:
+            rows = np.nonzero(np.abs(dense).sum(axis=tuple(
+                range(1, dense.ndim))))[0]
+        return SelectedRows(rows, dense[rows], dense.shape[0])
+
+    def merge(self, other):
+        """Row-wise add (sum op over SelectedRows inputs,
+        math/selected_rows_functor merge_add parity)."""
+        assert self.height == other.height
+        rows = np.concatenate([self.rows, other.rows])
+        vals = np.concatenate([self.value, other.value], axis=0)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        out = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(out, inv, vals)
+        return SelectedRows(uniq, out, self.height)
+
+    def __repr__(self):
+        return "SelectedRows(height=%d, nrows=%d, width=%s)" % (
+            self.height, len(self.rows),
+            self.value.shape[1:] if self.value.ndim > 1 else 1)

@@ -1,0 +1,15 @@
+"""Distributed training tier.
+
+Two layers, per SURVEY.md §5.8 / §7:
+  * ICI tier (dense): SPMD mesh sharding via paddle_tpu.parallel — XLA
+    collectives replace NCCL; nothing to do here.
+  * DCN tier (sparse / cross-slice): a parameter-server service with the
+    reference's RPC semantics (SendVariable / GetVariable /
+    PrefetchVariable — operators/detail/send_recv.proto:17-25), used for
+    pserver-mode DistributeTranspiler programs and the distributed sparse
+    lookup table.
+"""
+
+from .rpc import VariableServer, RPCClient  # noqa: F401
+from .transpiler import DistributeTranspiler  # noqa: F401
+from . import ops  # noqa: F401  (registers host ops)

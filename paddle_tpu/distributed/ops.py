@@ -1,0 +1,195 @@
+"""Distributed host ops: send / recv / prefetch / listen_and_serv /
+split_ids / split_selected_rows / merge_selected_rows / sum-over-rows.
+
+Reference parity: operators/{send,send_vars,send_barrier,recv,prefetch,
+listen_and_serv,split_ids,split_selected_rows}_op.cc. These are HOST ops —
+they do IO, so the Executor runs programs containing them in eager
+(op-interpreter) mode instead of whole-program XLA (core/executor.py
+_run_eager), exactly where the reference also left compiled-graph land.
+"""
+
+import numpy as np
+
+from ..core.registry import register
+from ..core.selected_rows import SelectedRows
+from .rpc import RPCClient
+
+
+_CLIENTS = {}
+
+
+def _client(ep):
+    cli = _CLIENTS.get(ep)
+    if cli is None:
+        cli = _CLIENTS[ep] = RPCClient(ep)
+    return cli
+
+
+def reset_clients():
+    for cli in _CLIENTS.values():
+        cli.close()
+    _CLIENTS.clear()
+
+
+@register("send", host=True)
+def _send(ctx, op):
+    """Push each input var to its endpoint (send_op.cc / send_vars)."""
+    eps = op.attr("epmap") or op.attr("endpoints") or []
+    names = op.input("X")
+    for i, name in enumerate(names):
+        ep = eps[i % len(eps)]
+        val = ctx.get(name)
+        if not isinstance(val, SelectedRows):
+            val = np.asarray(val)
+        _client(ep).send_var(op.attr("send_names", names)[i]
+                             if op.attr("send_names") else name, val)
+    for ep in set(eps):
+        if op.attr("sync", True):
+            _client(ep).barrier()
+
+
+@register("send_barrier", host=True)
+def _send_barrier(ctx, op):
+    for ep in (op.attr("endpoints") or []):
+        _client(ep).barrier()
+
+
+@register("recv", host=True)
+def _recv(ctx, op):
+    eps = op.attr("epmap") or op.attr("endpoints") or []
+    outs = op.output("Out")
+    fetch_names = op.attr("recv_names") or outs
+    for i, out in enumerate(outs):
+        ep = eps[i % len(eps)]
+        ctx.env[out] = _client(ep).get_var(fetch_names[i])
+
+
+@register("prefetch", host=True)
+def _prefetch(ctx, op):
+    """Fetch embedding rows by id from the sharded table
+    (prefetch_op.cc + distributed lookup table)."""
+    eps = op.attr("epmap") or op.attr("endpoints") or []
+    table = op.attr("table_name")
+    ids = np.asarray(ctx.in1(op, "X")).reshape(-1).astype(np.int64)
+    # shard ids across endpoints like split_ids (round robin by id % n)
+    n = len(eps)
+    parts = [ids[ids % n == i] for i in range(n)]
+    merged = None
+    for ep, part in zip(eps, parts):
+        if len(part) == 0:
+            continue
+        sr = _client(ep).prefetch(table, part)
+        merged = sr if merged is None else merged.merge(sr)
+    if merged is None:
+        merged = SelectedRows(height=0)
+    # return rows aligned with the request order
+    width = merged.value.shape[1] if merged.value.ndim > 1 else 1
+    lut = {int(r): i for i, r in enumerate(merged.rows)}
+    out = np.stack([merged.value[lut[int(i)]] for i in ids]) \
+        if len(ids) else np.zeros((0, width), np.float32)
+    ctx.set_out(op, "Out", out)
+
+
+@register("listen_and_serv", host=True)
+def _listen_and_serv(ctx, op):
+    """Run the parameter-server loop until shutdown
+    (listen_and_serv_op.cc:76-239). The optimize step per round runs the
+    op's sub-block through the eager interpreter with merged grads bound."""
+    from .rpc import VariableServer
+    from ..core.executor import _lower_op
+    from ..core.registry import LowerContext
+
+    fan_in = int(op.attr("Fanin", op.attr("fan_in", 1)))
+    endpoint = op.attr("endpoint", "127.0.0.1:0")
+    port_file = op.attr("port_file")
+    param_names = op.attr("param_names") or []
+    grad_names = op.attr("grad_names") or []
+    blocks = op.attr("optimize_blocks") or []
+    if not isinstance(blocks, (list, tuple)):
+        blocks = [blocks]
+
+    def optimize_fn(store, merged_grads):
+        env = dict(ctx.env)
+        env.update(store)
+        for p, g in zip(param_names, grad_names):
+            if g in merged_grads:
+                env[g] = merged_grads[g]
+            elif not any(gn in merged_grads for gn in (g,)):
+                continue
+        for g, val in merged_grads.items():
+            env[g] = val if not isinstance(val, SelectedRows) \
+                else val.to_dense()
+        sctx = LowerContext(env, ctx._rng_fn, executor=ctx.executor)
+        for blk in blocks:
+            for op2 in blk.ops:
+                _lower_op(sctx, op2)
+        for p in param_names:
+            if p in env:
+                store[p] = np.asarray(env[p])
+
+    host, port = endpoint.rsplit(":", 1)
+    server = VariableServer(host=host, port=int(port), fan_in=fan_in,
+                            optimize_fn=optimize_fn, port_file=port_file)
+    # publish initial params from the scope/env
+    for p in param_names:
+        if p in ctx.env:
+            server.store[p] = np.asarray(ctx.env[p])
+    server.start()
+    ctx.env["@PSERVER@"] = server
+    if op.attr("blocking", True):
+        server._shutdown.wait()
+    # commit updated params back
+    for p in param_names:
+        if p in server.store:
+            ctx.env[p] = server.store[p]
+
+
+@register("split_ids", host=True)
+def _split_ids(ctx, op):
+    ids = np.asarray(ctx.in1(op, "Ids")).reshape(-1).astype(np.int64)
+    outs = op.output("Out")
+    n = len(outs)
+    for i, out in enumerate(outs):
+        ctx.env[out] = ids[ids % n == i].reshape(-1, 1)
+
+
+@register("split_selected_rows", host=True)
+def _split_selected_rows(ctx, op):
+    sr = ctx.in1(op, "X")
+    outs = op.output("Out")
+    height_sections = op.attr("height_sections") or []
+    n = len(outs)
+    bounds = np.cumsum([0] + list(height_sections)) if height_sections \
+        else np.linspace(0, sr.height, n + 1).astype(np.int64)
+    for i, out in enumerate(outs):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        mask = (sr.rows >= lo) & (sr.rows < hi)
+        ctx.env[out] = SelectedRows(sr.rows[mask] - lo, sr.value[mask],
+                                    hi - lo)
+
+
+@register("merge_selected_rows", host=True)
+def _merge_selected_rows(ctx, op):
+    sr = ctx.in1(op, "X")
+    if isinstance(sr, SelectedRows):
+        uniq, inv = np.unique(sr.rows, return_inverse=True)
+        out = np.zeros((len(uniq),) + sr.value.shape[1:], sr.value.dtype)
+        np.add.at(out, inv, sr.value)
+        ctx.set_out(op, "Out", SelectedRows(uniq, out, sr.height))
+    else:
+        ctx.set_out(op, "Out", sr)
+
+
+@register("lookup_sparse_table", host=True)
+def _lookup_sparse_table(ctx, op):
+    """Local sparse-table lookup over a SelectedRows-stored table."""
+    w = ctx.in1(op, "W")
+    ids = np.asarray(ctx.in1(op, "Ids")).reshape(-1).astype(np.int64)
+    if isinstance(w, SelectedRows):
+        lut = {int(r): i for i, r in enumerate(w.rows)}
+        rows = np.stack([w.value[lut[int(i)]] if int(i) in lut
+                         else np.zeros(w.value.shape[1], w.value.dtype)
+                         for i in ids])
+    else:
+        rows = np.asarray(w)[np.clip(ids, 0, len(w) - 1)]
+    ctx.set_out(op, "Out", rows)

