@@ -1,0 +1,53 @@
+"""Perf probe: time the ResNet-50 train step at several batch sizes on the
+real chip and report MFU. Not part of the bench entry — a tuning tool."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet
+
+
+PEAK_BF16 = 197e12  # TPU v5e per-chip peak bf16 FLOP/s
+FLOPS_PER_IMG_TRAIN = 3 * 4.1e9  # fwd + ~2x bwd, ResNet-50 @224
+
+
+def run(bs, iters=8, warm=2):
+    fluid.amp.enable_amp()
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        sys.path.insert(0, "benchmarks")
+        from common import synthetic_feeds
+        synth = synthetic_feeds({
+            "data": ((bs, 3, 224, 224), "float32", 1.0),
+            "label": ((bs, 1), "int64", 1000)})
+        image, label, avg_cost, acc = resnet.build_train_net(
+            model="resnet_imagenet", depth=50, image_shape=(3, 224, 224),
+            num_classes=1000, learning_rate=0.01,
+            image=synth["data"], label=synth["label"])
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        for _ in range(warm):
+            loss, = exe.run(feed={}, fetch_list=[avg_cost])
+            float(np.asarray(loss))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss, = exe.run(feed={}, fetch_list=[avg_cost])
+        float(np.asarray(loss))
+        dt = (time.perf_counter() - t0) / iters
+    ips = bs / dt
+    mfu = ips * FLOPS_PER_IMG_TRAIN / PEAK_BF16
+    print("bs=%4d  %7.2f ms/step  %8.1f img/s  MFU=%5.1f%%"
+          % (bs, dt * 1e3, ips, mfu * 100), flush=True)
+    return ips
+
+
+if __name__ == "__main__":
+    for bs in [int(a) for a in sys.argv[1:]] or [64, 128, 256]:
+        run(bs)

@@ -100,6 +100,7 @@ def _listen_and_serv(ctx, op):
     from ..core.registry import LowerContext
 
     fan_in = int(op.attr("Fanin", op.attr("fan_in", 1)))
+    sync_mode = bool(op.attr("sync_mode", True))
     endpoint = op.attr("endpoint", "127.0.0.1:0")
     port_file = op.attr("port_file")
     param_names = op.attr("param_names") or []
@@ -111,17 +112,22 @@ def _listen_and_serv(ctx, op):
     def optimize_fn(store, merged_grads):
         env = dict(ctx.env)
         env.update(store)
-        for p, g in zip(param_names, grad_names):
-            if g in merged_grads:
-                env[g] = merged_grads[g]
-            elif not any(gn in merged_grads for gn in (g,)):
-                continue
         for g, val in merged_grads.items():
             env[g] = val if not isinstance(val, SelectedRows) \
                 else val.to_dense()
         sctx = LowerContext(env, ctx._rng_fn, executor=ctx.executor)
+        # async mode delivers one grad at a time: skip optimize ops whose
+        # grad input didn't arrive, and propagate the skip transitively so
+        # consumers of a skipped op's outputs (e.g. clip → sgd chains)
+        # don't run against missing/stale values
+        tainted = {g for g in grad_names if g not in merged_grads}
         for blk in blocks:
             for op2 in blk.ops:
+                refs = [n for ns in op2.inputs.values() for n in ns]
+                if any(n in tainted for n in refs):
+                    tainted.update(n for ns in op2.outputs.values()
+                                   for n in ns)
+                    continue
                 _lower_op(sctx, op2)
         for p in param_names:
             if p in env:
@@ -129,7 +135,8 @@ def _listen_and_serv(ctx, op):
 
     host, port = endpoint.rsplit(":", 1)
     server = VariableServer(host=host, port=int(port), fan_in=fan_in,
-                            optimize_fn=optimize_fn, port_file=port_file)
+                            optimize_fn=optimize_fn, port_file=port_file,
+                            sync=sync_mode)
     # publish initial params from the scope/env
     for p in param_names:
         if p in ctx.env:

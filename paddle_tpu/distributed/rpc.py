@@ -59,7 +59,7 @@ def deserialize_var(buf):
     return np.frombuffer(body, head["dtype"]).reshape(head["shape"]).copy()
 
 
-def _send_msg(sock, op, name, payload=b""):
+def _send_msg(sock, op, name="", payload=b""):
     nb = name.encode()
     sock.sendall(struct.pack("<4sII", op.encode().ljust(4), len(nb),
                              len(payload)) + nb + payload)
@@ -91,11 +91,12 @@ class VariableServer:
     round barrier, after which `optimize_fn` is invoked once per round."""
 
     def __init__(self, host="127.0.0.1", port=0, fan_in=1,
-                 optimize_fn=None, port_file=None):
+                 optimize_fn=None, port_file=None, sync=True):
         self.store = {}              # name -> np.ndarray
         self.grads = {}              # name -> list of pending grads
         self.fan_in = fan_in
         self.optimize_fn = optimize_fn
+        self.sync = sync             # False → async SGD: apply on arrival
         self._lock = threading.Lock()
         self._round_cv = threading.Condition(self._lock)
         self._barrier_count = 0
@@ -135,15 +136,27 @@ class VariableServer:
         self._shutdown.set()
         with self._round_cv:
             self._round_cv.notify_all()
-        self._server.shutdown()
+        # shutdown() handshakes with serve_forever; if the serve thread was
+        # never started that handshake would block forever — just close.
+        if self._thread.is_alive():
+            self._server.shutdown()
         self._server.server_close()
 
     # -- dispatch ------------------------------------------------------------
     def _dispatch(self, sock, op, name, payload):
         if op == "SEND":
             value = deserialize_var(payload)
-            with self._lock:
-                self.grads.setdefault(name, []).append(value)
+            if self.sync:
+                with self._lock:
+                    self.grads.setdefault(name, []).append(value)
+            else:
+                # Async SGD (ParameterServer2.h async paths /
+                # async_update.md): apply this gradient immediately under
+                # the lock — no round barrier, trainers never wait on each
+                # other, updates may be stale.
+                with self._lock:
+                    if self.optimize_fn is not None:
+                        self.optimize_fn(self.store, {name: value})
             _send_msg(sock, "OK")
         elif op == "GET":
             with self._lock:
@@ -169,7 +182,10 @@ class VariableServer:
                 self.store[name] = np.asarray(deserialize_var(payload))
             _send_msg(sock, "OK")
         elif op == "BARR":
-            self._barrier(sock)
+            if self.sync:
+                self._barrier(sock)
+            else:
+                _send_msg(sock, "OK")   # async mode: barrier is a no-op
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()
@@ -209,9 +225,16 @@ class VariableServer:
 class RPCClient:
     """Trainer-side client (grpc_client.h:160-194 RPCClient parity, sync)."""
 
-    def __init__(self, endpoint):
+    def __init__(self, endpoint, timeout=60.0):
         host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)))
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        # Steady-state recv timeout: a dead/hung server raises
+        # socket.timeout instead of deadlocking the whole test suite
+        # (grpc deadline parity). barrier() lifts it — a sync-mode barrier
+        # legitimately blocks until the slowest trainer arrives.
+        self._sock.settimeout(timeout)
+        self._timeout = timeout
 
     def send_var(self, name, value):
         _send_msg(self._sock, "SEND", name, serialize_var(value))
@@ -238,7 +261,13 @@ class RPCClient:
 
     def barrier(self):
         _send_msg(self._sock, "BARR", "")
-        assert _recv_msg(self._sock)[0] == "OK"
+        # no deadline: the server replies only after all fan_in trainers
+        # arrive, which can take arbitrarily long (slow peers, compiles)
+        self._sock.settimeout(None)
+        try:
+            assert _recv_msg(self._sock)[0] == "OK"
+        finally:
+            self._sock.settimeout(self._timeout)
 
     def shutdown_server(self):
         try:

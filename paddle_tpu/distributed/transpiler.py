@@ -17,10 +17,21 @@ Two modes:
     localhost multi-process test pattern (test_dist_train.py).
 """
 
-from ..core.program import default_main_program, Program
+from ..core.program import (default_main_program, default_startup_program,
+                            Program)
 from ..core import unique_name
 
 __all__ = ["DistributeTranspiler"]
+
+
+def _clone_op_vars(src_block, dst_block, op):
+    """Declare every var an op references into dst_block (persistable) so
+    the cloned op can resolve them — shared by pserver/startup builders."""
+    for name in op.input_names + op.output_names:
+        v = src_block.vars.get(name)
+        if v is not None and not dst_block.has_var(name):
+            dst_block.create_var(name=name, shape=v.shape, dtype=v.dtype,
+                                 persistable=True)
 
 
 class DistributeTranspiler:
@@ -30,6 +41,7 @@ class DistributeTranspiler:
         self._trainers = 1
         self._eps = []
         self._program = None
+        self._startup = None
         self._param_grads = []
 
     # ------------------------------------------------------------------
@@ -37,6 +49,7 @@ class DistributeTranspiler:
                   sync_mode=True, startup_program=None):
         program = program or default_main_program()
         self._program = program
+        self._startup = startup_program or default_startup_program()
         self._trainer_id = trainer_id
         self._trainers = trainers
         self._eps = [e for e in pservers.split(",") if e]
@@ -71,7 +84,7 @@ class DistributeTranspiler:
         n = max(1, len(self._eps))
         epmap_g = [self._eps[i % n] for i in range(len(grads))]
         gb.append_op(type="send", inputs={"X": grads}, outputs={},
-                     attrs={"epmap": epmap_g, "sync": True,
+                     attrs={"epmap": epmap_g, "sync": self._sync,
                             "endpoints": self._eps})
         gb.append_op(type="recv", inputs={},
                      outputs={"Out": params},
@@ -92,24 +105,13 @@ class DistributeTranspiler:
         owns (round-robin placement like distributed_splitter)."""
         prog = Program()
         gb = prog.global_block()
-        n = max(1, len(self._eps))
-        try:
-            my_idx = self._eps.index(endpoint)
-        except ValueError:
-            my_idx = 0
-        my = [(i, pg) for i, pg in enumerate(self._param_grads)
-              if i % n == my_idx]
+        my = self._owned(endpoint)
 
         opt_block = prog.create_block()
         src_gb = self._program.global_block()
         for i, (p, g) in my:
             op = self._opt_ops[i]
-            # clone vars referenced by the optimize op into the server prog
-            for name in op.input_names + op.output_names:
-                v = src_gb.vars.get(name)
-                if v is not None and not gb.has_var(name):
-                    gb.create_var(name=name, shape=v.shape, dtype=v.dtype,
-                                  persistable=True)
+            _clone_op_vars(src_gb, gb, op)
             opt_block.append_op(op.type, dict(op.inputs), dict(op.outputs),
                                 dict(op.attrs))
         prog.rollback()
@@ -117,6 +119,7 @@ class DistributeTranspiler:
             type="listen_and_serv", inputs={}, outputs={},
             attrs={"endpoint": endpoint,
                    "Fanin": self._trainers,
+                   "sync_mode": self._sync,
                    "param_names": [p for _, (p, g) in my],
                    "grad_names": [g for _, (p, g) in my],
                    "optimize_blocks": [opt_block],
@@ -125,6 +128,47 @@ class DistributeTranspiler:
         return prog
 
     def get_startup_program(self, endpoint=None, pserver_program=None):
-        """Server startup: initialize owned params (same initializers as
-        the trainer's startup program)."""
-        return Program()
+        """Server startup: a Program that initializes exactly the params
+        this endpoint owns, by cloning the matching initializer ops out of
+        the trainer's startup program (distribute_transpiler.py
+        get_startup_program per-endpoint init parity)."""
+        owned = set(self._owned_param_names(endpoint))
+        prog = Program()
+        gb = prog.global_block()
+        if self._startup is None:
+            return prog
+        src = self._startup.global_block()
+        for op in src.ops:
+            out_names = [n for ns in op.outputs.values() for n in ns]
+            if not any(n in owned for n in out_names):
+                continue
+            _clone_op_vars(src, gb, op)
+            gb.append_op(op.type, dict(op.inputs), dict(op.outputs),
+                         dict(op.attrs))
+        return prog
+
+    def _owned(self, endpoint=None):
+        """Round-robin param placement (distributed_splitter parity):
+        [(index, (param, grad))] owned by `endpoint`. The single source of
+        truth for placement — get_pserver_program and get_startup_program
+        must agree or a server would init a shard it doesn't serve."""
+        n = max(1, len(self._eps))
+        if endpoint is None:
+            if n > 1:
+                raise ValueError(
+                    "endpoint is required when transpiling for %d pservers"
+                    " %r — each server owns a different param shard"
+                    % (n, self._eps))
+            my_idx = 0
+        else:
+            try:
+                my_idx = self._eps.index(endpoint)
+            except ValueError:
+                raise ValueError(
+                    "endpoint %r is not one of the transpiled pserver "
+                    "endpoints %r" % (endpoint, self._eps))
+        return [(i, pg) for i, pg in enumerate(self._param_grads)
+                if i % n == my_idx]
+
+    def _owned_param_names(self, endpoint=None):
+        return [p for _, (p, g) in self._owned(endpoint)]

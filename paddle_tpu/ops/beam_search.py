@@ -1,0 +1,159 @@
+"""Beam search ops, TPU-first.
+
+Reference parity: operators/beam_search_op.cc:1 (per-step top-k selection
+with end-of-sentence pruning) and beam_search_decode_op.cc:1 (backtracking
+the beam tree into finished sentences).
+
+The reference walks variable-length LoD levels with host loops and builds a
+pointer tree (BeamNode) for decoding. Neither maps to the MXU/XLA model, so
+the design here is dense and static-shaped:
+
+* every source sentence always owns exactly ``beam_size`` rows — dead beams
+  (those that already emitted ``end_id``) stay in the tensor, are masked to
+  -inf so they never win, and re-emit ``end_id`` with a frozen score;
+* selection is one ``lax.top_k`` over the flattened ``beam_size * K``
+  candidate table per source — no data-dependent shapes;
+* decoding is a reverse ``lax.scan`` over the recorded parent pointers
+  (the functional equivalent of the BeamNode backtrack), producing padded
+  ``[batch, beam, max_len]`` sentences.
+
+This is the same dense formulation the step-level op AND the whole-loop
+functional decoder (models/decoding.py) share, so a Program built from
+layers.beam_search and a jitted scan decode select identical beams.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+NEG_INF = -1e9
+
+
+def beam_search_step(pre_ids, pre_scores, scores, beam_size, end_id,
+                     first_step=False):
+    """One dense beam-search step.
+
+    Args:
+      pre_ids:    [B*W] int32 — token chosen at the previous step.
+      pre_scores: [B*W] f32 — accumulated log-prob per beam.
+      scores:     [B*W, V] f32 — *local* log-probs for the next token
+                  (log softmax of the decoder output).
+      beam_size:  W.
+      end_id:     EOS token id.
+      first_step: if True, only beam 0 of each source is live (all beams
+                  hold identical state at t=0, so without this every source
+                  would select W copies of the same token).
+
+    Returns (selected_ids [B*W] i32, selected_scores [B*W] f32,
+             parent_idx [B*W] i32 — index into the previous step's B*W rows).
+    """
+    bw, vocab = scores.shape
+    batch = bw // beam_size
+    finished = (pre_ids == end_id)
+
+    # accumulated candidate table: alive beams extend by every token;
+    # finished beams contribute exactly one frozen candidate at end_id.
+    acc = pre_scores[:, None] + scores                 # [B*W, V]
+    acc = jnp.where(finished[:, None], NEG_INF, acc)
+    frozen = jnp.full((bw, vocab), NEG_INF, acc.dtype)
+    frozen = frozen.at[:, end_id].set(
+        jnp.where(finished, pre_scores, NEG_INF))
+    cand = jnp.maximum(acc, frozen)                    # [B*W, V]
+
+    if first_step:
+        beam_pos = jnp.arange(bw) % beam_size
+        cand = jnp.where((beam_pos > 0)[:, None], NEG_INF, cand)
+
+    flat = cand.reshape(batch, beam_size * vocab)
+    top_scores, top_idx = lax.top_k(flat, beam_size)   # [B, W]
+    parent_in_src = top_idx // vocab                   # [B, W] ∈ [0, W)
+    token = top_idx % vocab
+    src_base = jnp.arange(batch)[:, None] * beam_size
+    parent_idx = (src_base + parent_in_src).reshape(-1)
+    return (token.reshape(-1).astype(jnp.int32),
+            top_scores.reshape(-1),
+            parent_idx.astype(jnp.int32))
+
+
+def beam_search_decode(step_ids, step_parents, final_scores, beam_size,
+                       end_id):
+    """Backtrack recorded steps into sentences.
+
+    Args:
+      step_ids:     [T, B*W] i32 — selected token per step.
+      step_parents: [T, B*W] i32 — parent row per step.
+      final_scores: [B*W] f32 — accumulated score of each final beam.
+      beam_size, end_id: as above.
+
+    Returns (sentences [B, W, T] i32 padded with end_id after EOS,
+             scores [B, W] f32).
+    """
+    T, bw = step_ids.shape
+    batch = bw // beam_size
+
+    def back(carry, xs):
+        row = carry                       # [B*W] current row per final beam
+        ids_t, par_t = xs                 # each [B*W]
+        tok = ids_t[row]
+        prev = par_t[row]
+        return prev, tok
+
+    rows0 = jnp.arange(bw, dtype=jnp.int32)
+    _, toks_rev = lax.scan(back, rows0, (step_ids[::-1], step_parents[::-1]))
+    sentences = toks_rev[::-1].T          # [B*W, T]
+
+    # pad everything after the first end_id with end_id
+    seen_end = jnp.cumsum((sentences == end_id).astype(jnp.int32), axis=1)
+    after_end = jnp.concatenate(
+        [jnp.zeros((bw, 1), jnp.int32), seen_end[:, :-1]], axis=1) > 0
+    sentences = jnp.where(after_end, end_id, sentences)
+    return (sentences.reshape(batch, beam_size, T),
+            final_scores.reshape(batch, beam_size))
+
+
+# --------------------------------------------------------------------------
+# Program-IR op lowerings
+# --------------------------------------------------------------------------
+
+@register("beam_search")
+def _beam_search(ctx, op):
+    """Dense per-step op (beam_search_op.cc). Inputs pre_ids [B*W,1],
+    pre_scores [B*W,1], scores [B*W,V]; attrs beam_size, end_id,
+    is_first_step. The `ids` slot of the reference (pre-selected candidate
+    ids) is unnecessary in the dense form — scores covers the full vocab."""
+    pre_ids = ctx.in1(op, "pre_ids").reshape(-1)
+    pre_scores = ctx.in1(op, "pre_scores").reshape(-1).astype(jnp.float32)
+    scores = ctx.in1(op, "scores")
+    sel, sc, par = beam_search_step(
+        pre_ids, pre_scores, scores,
+        int(op.attr("beam_size", 4)), int(op.attr("end_id", 0)),
+        bool(op.attr("is_first_step", False)))
+    ctx.set_out(op, "selected_ids", sel[:, None])
+    ctx.set_out(op, "selected_scores", sc[:, None])
+    ctx.set_out(op, "parent_idx", par)
+
+
+@register("beam_search_decode")
+def _beam_search_decode(ctx, op):
+    """Backtracking decode (beam_search_decode_op.cc). Inputs Ids / Parents
+    as LoDTensorArrays (lists of [B*W,1] per step) or stacked [T,B*W]
+    tensors, Scores [B*W,1] accumulated; outputs SentenceIds [B,W,T],
+    SentenceScores [B,W]."""
+    ids = ctx.in1(op, "Ids")
+    parents = ctx.in1(op, "Parents")
+    scores = ctx.in1(op, "Scores")
+    if isinstance(ids, list):
+        ids = jnp.stack([jnp.asarray(a).reshape(-1) for a in ids])
+    else:
+        ids = jnp.asarray(ids).reshape(ids.shape[0], -1)
+    if isinstance(parents, list):
+        parents = jnp.stack([jnp.asarray(a).reshape(-1) for a in parents])
+    else:
+        parents = jnp.asarray(parents).reshape(parents.shape[0], -1)
+    sent, sc = beam_search_decode(
+        ids.astype(jnp.int32), parents.astype(jnp.int32),
+        jnp.asarray(scores).reshape(-1).astype(jnp.float32),
+        int(op.attr("beam_size", 4)), int(op.attr("end_id", 0)))
+    ctx.set_out(op, "SentenceIds", sent)
+    ctx.set_out(op, "SentenceScores", sc)

@@ -14,7 +14,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -74,5 +74,5 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp"):
     fn = shard_map(
         functools.partial(_gpipe_sharded, stage_fn=stage_fn,
                           axis_name=axis_name),
-        mesh=mesh, in_specs=(pspec, P()), out_specs=P(), check_rep=False)
+        mesh=mesh, in_specs=(pspec, P()), out_specs=P(), check_vma=False)
     return fn(stacked_params, microbatches)

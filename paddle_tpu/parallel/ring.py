@@ -15,7 +15,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -83,7 +83,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
         functools.partial(_ring_attention_sharded, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)
 
 
@@ -113,5 +113,5 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_rep=False)
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -161,6 +161,124 @@ def test_pserver_mode_training_matches_local():
     np.testing.assert_allclose(w_dist, w_local, rtol=1e-4, atol=1e-5)
 
 
+def test_variable_server_async_mode():
+    """Async SGD (ParameterServer2 async paths): each SEND applies its
+    gradient immediately — no fan-in barrier, updates may be stale."""
+    def opt(store, grads):
+        for k, g in grads.items():
+            p = k.replace("@GRAD", "")
+            if p in store:
+                store[p] = store[p] - 0.1 * (
+                    g.to_dense() if isinstance(g, SelectedRows)
+                    else np.asarray(g))
+
+    server = VariableServer(fan_in=2, optimize_fn=opt, sync=False).start()
+    try:
+        c1 = RPCClient("127.0.0.1:%d" % server.port)
+        c2 = RPCClient("127.0.0.1:%d" % server.port)
+        w = np.ones((4, 2), np.float32)
+        c1.put_var("w", w)
+        g = np.full((4, 2), 1.0, np.float32)
+        # send without any barrier: applied on arrival, sequentially stale
+        c1.send_var("w@GRAD", g)
+        np.testing.assert_allclose(c1.get_var("w"), 0.9, rtol=1e-6)
+        c2.send_var("w@GRAD", g)
+        np.testing.assert_allclose(c2.get_var("w"), 0.8, rtol=1e-6)
+        # barrier is a no-op in async mode (doesn't block on fan_in=2)
+        c1.barrier()
+    finally:
+        server.stop()
+        dist_ops.reset_clients()
+
+
+def test_async_pserver_training_reaches_local_loss():
+    """1-trainer async pserver run converges to the sync/local result:
+    with a single trainer, apply-on-arrival is the same update sequence."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4).astype(np.float32)
+    yv = (xv @ np.array([1., 2., 3., 4.], np.float32))[:, None]
+
+    loss = _build_trainer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(5):
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    w_local = np.asarray(fluid.global_scope().find_var("w_dist")).copy()
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = fluid.Scope()
+    with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2):
+        _build_trainer()
+        t = fluid.DistributeTranspiler(mode="pserver")
+        t.transpile(trainer_id=0, program=main2, pservers="127.0.0.1:0",
+                    trainers=1, sync_mode=False)
+        probe = VariableServer()
+        port = probe.port
+        probe.stop()
+        ep = "127.0.0.1:%d" % port
+        t._eps = [ep]
+        for op in main2.global_block().ops:
+            if op.type in ("send", "recv"):
+                op.attrs["epmap"] = [ep] * len(op.attrs.get("epmap", [ep]))
+                op.attrs["endpoints"] = [ep]
+        pserver_prog = t.get_pserver_program(ep)
+        server_scope = fluid.Scope()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope2):
+            exe2.run(startup2)
+        server_scope.set("w_dist", np.zeros((4, 1), np.float32))
+        lanv = [op for op in pserver_prog.global_block().ops
+                if op.type == "listen_and_serv"][0]
+        assert lanv.attr("sync_mode") is False
+        opt_blk = lanv.attr("optimize_blocks")[0]
+        lr_name = opt_blk.ops[0].input("LearningRate")[0]
+        server_scope.set(lr_name, np.asarray([0.1], np.float32))
+
+        def run_server(pserver_prog, scope):
+            srv_exe = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(scope):
+                srv_exe.run(pserver_prog, feed={}, fetch_list=[])
+
+        th = threading.Thread(target=run_server,
+                              args=(pserver_prog, server_scope),
+                              daemon=True)
+        th.start()
+        time.sleep(0.5)
+        try:
+            for _ in range(5):
+                exe2.run(main2, feed={"x": xv, "y": yv}, fetch_list=[],
+                         scope=scope2)
+            w_dist = np.asarray(scope2.find_var("w_dist")).copy()
+        finally:
+            cli = RPCClient(ep)
+            cli.shutdown_server()
+            cli.close()
+            dist_ops.reset_clients()
+        th.join(timeout=5)
+
+    np.testing.assert_allclose(w_dist, w_local, rtol=1e-4, atol=1e-5)
+
+
+def test_pserver_startup_program_initializes_owned_params():
+    """get_startup_program clones the owned params' initializer ops
+    (no longer an empty-Program stub)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        _build_trainer()
+        t = fluid.DistributeTranspiler(mode="pserver")
+        t.transpile(trainer_id=0, program=main,
+                    pservers="127.0.0.1:6170", trainers=1,
+                    startup_program=startup)
+        sprog = t.get_startup_program("127.0.0.1:6170")
+        assert len(sprog.global_block().ops) >= 1
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(sprog)
+        w = np.asarray(scope.find_var("w_dist"))
+        np.testing.assert_allclose(w, np.zeros((4, 1), np.float32))
+
+
 def test_split_ids_and_selected_rows_ops():
     ids = np.array([[0], [3], [4], [7]], np.int64)
     x = fluid.layers.data("ids", [1], dtype="int64")
