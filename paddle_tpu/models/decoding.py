@@ -1,0 +1,103 @@
+"""Whole-loop sequence generation: greedy + beam search as one lax.scan.
+
+Reference parity: the While-loop + beam_search + beam_search_decode program
+of test_machine_translation.py:138-192 and the legacy generation machine
+(gserver/gradientmachines/RecurrentGradientMachine.h:32). There the decode
+loop is a host-interpreted While with dynamic-shaped LoD pruning; here the
+whole decode is ONE jitted lax.scan with static [batch*beam] shapes — dead
+beams are masked, not pruned — so the entire generation loop compiles to a
+single XLA while-op on the TPU with no host round-trips per token.
+
+Works with any step function ``logits_fn(tokens, state, t) -> (logits,
+state)`` where tokens is [rows] int32 (current token per row), state is an
+arbitrary pytree whose leading-batch-dim arrays get reordered by beam
+backtracking (KV caches), and logits is [rows, vocab].
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.beam_search import beam_search_step, beam_search_decode
+
+__all__ = ["greedy_search", "beam_search"]
+
+
+def greedy_search(logits_fn, init_state, bos_id, end_id, max_len, batch):
+    """Greedy decode: [batch] rows, argmax each step.
+
+    Returns (tokens [batch, max_len] i32, scores [batch] f32 — sum of token
+    log-probs)."""
+
+    def step(carry, t):
+        tok, state, score, done = carry
+        logits, state = logits_fn(tok, state, t)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        tok_logp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+        nxt = jnp.where(done, end_id, nxt)
+        score = score + jnp.where(done, 0.0, tok_logp)
+        done = done | (nxt == end_id)
+        return (nxt, state, score, done), nxt
+
+    tok0 = jnp.full((batch,), bos_id, jnp.int32)
+    score0 = jnp.zeros((batch,), jnp.float32)
+    done0 = jnp.zeros((batch,), bool)
+    (_, _, score, _), toks = lax.scan(
+        step, (tok0, init_state, score0, done0), jnp.arange(max_len))
+    return toks.T, score
+
+
+def _reorder_state(state, parent_idx):
+    """Gather every leading-dim array of the state pytree by parent_idx —
+    the KV-cache shuffle that replaces the reference's beam pruning copies."""
+    return jax.tree_util.tree_map(
+        lambda a: a[parent_idx] if hasattr(a, "ndim") and a.ndim >= 1
+        and a.shape[0] == parent_idx.shape[0] else a, state)
+
+
+def beam_search(logits_fn, init_state, bos_id, end_id, max_len, batch,
+                beam_size, length_penalty=0.0):
+    """Beam-search decode. State rows are [batch*beam] (tile the encoder
+    state beam_size times along dim 0 before calling).
+
+    Returns (sentences [batch, beam, max_len] i32 — best beam first,
+    scores [batch, beam] f32, sorted descending)."""
+    rows = batch * beam_size
+
+    def step(carry, t):
+        tok, state, score = carry
+        logits, state = logits_fn(tok, state, t)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        # the scan covers t>=1 (step 0 is unrolled below), never first_step
+        sel, new_score, parent = beam_search_step(
+            tok, score, logp, beam_size, end_id, first_step=False)
+        state = _reorder_state(state, parent)
+        return (sel, state, new_score), (sel, parent)
+
+    # first_step must be a trace-time constant → unroll step 0, scan the rest
+    tok0 = jnp.full((rows,), bos_id, jnp.int32)
+    score0 = jnp.zeros((rows,), jnp.float32)
+    state = init_state
+    logits, state = logits_fn(tok0, state, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    sel0, score, parent0 = beam_search_step(tok0, score0, logp, beam_size,
+                                            end_id, first_step=True)
+    state = _reorder_state(state, parent0)
+
+    (tok_f, _, score), (sel_rest, parent_rest) = lax.scan(
+        step, (sel0, state, score), jnp.arange(1, max_len))
+
+    step_ids = jnp.concatenate([sel0[None], sel_rest])        # [T, rows]
+    step_parents = jnp.concatenate([parent0[None], parent_rest])
+    sentences, scores = beam_search_decode(step_ids, step_parents, score,
+                                           beam_size, end_id)
+
+    if length_penalty:
+        lengths = jnp.sum((sentences != end_id).astype(jnp.float32), -1) + 1
+        scores = scores / (lengths ** length_penalty)
+
+    order = jnp.argsort(-scores, axis=-1)                     # [B, W]
+    sentences = jnp.take_along_axis(sentences, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return sentences, scores

@@ -125,8 +125,9 @@ def make_attn_bias(mask_2d, n_head, causal=False, seq_len=None):
     """mask_2d: [B, T] 1/0 validity → additive bias [B, H, T, T]."""
     b, t = mask_2d.shape[0], mask_2d.shape[1]
     key_mask = layers.reshape(mask_2d, [b, 1, 1, t])
-    bias = layers.scale(key_mask, 1e9, bias=-1e9, bias_after_scale=False)
-    # (mask-1)*1e9 : 0 where valid, -1e9 where padding
+    # (mask-1)*1e9 : 0 where valid, -1e9 where padding.
+    # scale(bias_after_scale=False) computes scale*(x+bias) → bias=-1.0
+    bias = layers.scale(key_mask, 1e9, bias=-1.0, bias_after_scale=False)
     bias = layers.expand(bias, expand_times=[1, n_head, t, 1])
     if causal:
         tri = np.triu(np.ones((t, t), np.float32), k=1) * -1e9
@@ -202,7 +203,7 @@ def transformer(src_vocab_size=4096, trg_vocab_size=4096, max_len=64,
     b = src_mask.shape[0]
     t = max_len
     key_mask = layers.reshape(src_mask, [b, 1, 1, t])
-    cross_bias = layers.scale(key_mask, 1e9, bias=-1e9,
+    cross_bias = layers.scale(key_mask, 1e9, bias=-1.0,
                               bias_after_scale=False)
     cross_bias = layers.expand(cross_bias, expand_times=[1, n_head, t, 1])
     dec = dec_in

@@ -1,0 +1,244 @@
+"""Transformer inference: KV-cached incremental decode + beam search.
+
+Reference parity: the decode path of test_machine_translation.py (While loop
++ beam_search ops over the RNN/transformer decoder) and the C++ inference
+engine's transformer serving story. TPU-first: instead of interpreting the
+training Program per token, the trained parameters are *extracted* from the
+Program/Scope (in parameterized-op order, with loud role assertions) into a
+pure-JAX incremental decoder — one jitted function containing the whole
+generation loop (models/decoding.py lax.scan), KV caches updated with
+dynamic_update_slice, beam reordering as a batched gather.
+
+Works on any model built by models/transformer.transformer(); if the
+builder's op sequence changes, the cursor assertions fail loudly rather
+than silently mis-wiring weights.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import decoding
+
+__all__ = ["extract_params", "TransformerInfer"]
+
+
+_PARAM_OPS = {
+    "lookup_table": ("lookup", "W"),
+    "mul": ("mul", "Y"),
+    "matmul": ("mul", "Y"),
+    "elementwise_add": ("bias", "Y"),
+    "layer_norm": ("layer_norm", None),
+}
+
+
+def extract_params(program, scope):
+    """Walk the program's ops in order; yield (role, arrays) for every op
+    that consumes a persistable parameter. This is the bridge from the
+    Program IR to the pure-JAX inference model."""
+    gb = program.global_block()
+    persistable = {v.name for v in gb.vars.values() if v.persistable}
+    out = []
+    for op in gb.ops:
+        if op.type not in _PARAM_OPS:
+            continue
+        role, slot = _PARAM_OPS[op.type]
+        if role == "layer_norm":
+            names = [op.input("Scale")[0], op.input("Bias")[0]]
+            out.append((role, [jnp.asarray(scope.find_var(n))
+                               for n in names]))
+            continue
+        names = op.input(slot)
+        if not names or names[0] not in persistable:
+            continue  # residual adds etc.
+        out.append((role, [jnp.asarray(scope.find_var(names[0]))]))
+    return out
+
+
+class _Cursor:
+    def __init__(self, items):
+        self._items = items
+        self._i = 0
+
+    def take(self, role):
+        if self._i >= len(self._items):
+            raise AssertionError("parameter stream exhausted wanting %r"
+                                 % role)
+        got_role, arrays = self._items[self._i]
+        if got_role != role:
+            raise AssertionError(
+                "parameter stream mismatch at %d: wanted %r got %r — "
+                "training builder and inference replayer out of sync"
+                % (self._i, role, got_role))
+        self._i += 1
+        return arrays[0] if len(arrays) == 1 else arrays
+
+    def done(self):
+        if self._i != len(self._items):
+            raise AssertionError("unconsumed parameters: %d of %d used"
+                                 % (self._i, len(self._items)))
+
+
+def _split_heads(x, n_head):
+    # [rows, T, H*dk] -> [rows, H, T, dk]
+    r, t = x.shape[0], x.shape[1]
+    return x.reshape(r, t, n_head, -1).transpose(0, 2, 1, 3)
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    mean = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+
+
+class TransformerInfer:
+    """Replays models/transformer.transformer() weights for fast decode."""
+
+    def __init__(self, program, scope, n_layer, n_head, d_model, max_len,
+                 bos_id=1, end_id=2):
+        self.n_layer, self.n_head = n_layer, n_head
+        self.d_model, self.max_len = d_model, max_len
+        self.bos_id, self.end_id = bos_id, end_id
+        stream = extract_params(program, scope)
+        cur = _Cursor(stream)
+        # --- encoder params (builder order: embed, n_layer x enc layer) ---
+        self.src_word_emb = cur.take("lookup")
+        self.src_pos_emb = cur.take("lookup")
+        self.enc_layers = [self._take_attn_ffn(cur) for _ in range(n_layer)]
+        # --- decoder params ---
+        self.trg_word_emb = cur.take("lookup")
+        self.trg_pos_emb = cur.take("lookup")
+        self.dec_layers = [self._take_dec_layer(cur) for _ in range(n_layer)]
+        self.w_out = cur.take("mul")
+        cur.done()
+
+    @staticmethod
+    def _take_mha(cur):
+        return {"wq": cur.take("mul"), "wk": cur.take("mul"),
+                "wv": cur.take("mul"), "wo": cur.take("mul")}
+
+    def _take_attn_ffn(self, cur):
+        p = {"attn": self._take_mha(cur)}
+        p["ln1"] = cur.take("layer_norm")
+        p["ffn_w1"], p["ffn_b1"] = cur.take("mul"), cur.take("bias")
+        p["ffn_w2"], p["ffn_b2"] = cur.take("mul"), cur.take("bias")
+        p["ln2"] = cur.take("layer_norm")
+        return p
+
+    def _take_dec_layer(self, cur):
+        p = {"self": self._take_mha(cur)}
+        p["ln1"] = cur.take("layer_norm")
+        p["cross"] = self._take_mha(cur)
+        p["ln2"] = cur.take("layer_norm")
+        p["ffn_w1"], p["ffn_b1"] = cur.take("mul"), cur.take("bias")
+        p["ffn_w2"], p["ffn_b2"] = cur.take("mul"), cur.take("bias")
+        p["ln3"] = cur.take("layer_norm")
+        return p
+
+    # ------------------------------------------------------------------
+    def _mha(self, p, q_in, kv_k, kv_v, bias):
+        """q_in [rows, Tq, D]; kv_k/v [rows, H, Tk, dk]; bias broadcastable
+        to [rows, H, Tq, Tk]."""
+        h = self.n_head
+        q = _split_heads(q_in @ p["wq"], h)
+        dk = q.shape[-1]
+        s = jnp.einsum("rhqd,rhkd->rhqk", q * (dk ** -0.5), kv_k,
+                       preferred_element_type=jnp.float32)
+        if bias is not None:
+            s = s + bias
+        w = jax.nn.softmax(s, axis=-1).astype(kv_v.dtype)
+        o = jnp.einsum("rhqk,rhkd->rhqd", w, kv_v)
+        r, t = q_in.shape[0], q_in.shape[1]
+        return o.transpose(0, 2, 1, 3).reshape(r, t, -1) @ p["wo"]
+
+    def _kv(self, p, x):
+        h = self.n_head
+        return _split_heads(x @ p["wk"], h), _split_heads(x @ p["wv"], h)
+
+    def _ffn(self, p, x):
+        hdn = jax.nn.relu(x @ p["ffn_w1"] + p["ffn_b1"])
+        return hdn @ p["ffn_w2"] + p["ffn_b2"]
+
+    def encode(self, src_tokens, src_mask):
+        """src_tokens [B, T] int32, src_mask [B, T] float; → [B, T, D]."""
+        t = src_tokens.shape[1]
+        x = self.src_word_emb[src_tokens] * (self.d_model ** 0.5) \
+            + self.src_pos_emb[:t][None]
+        bias = (src_mask[:, None, None, :] - 1.0) * 1e9
+        for p in self.enc_layers:
+            k, v = self._kv(p["attn"], x)
+            a = self._mha(p["attn"], x, k, v, bias)
+            x = _ln(x + a, *p["ln1"])
+            x = _ln(x + self._ffn(p, x), *p["ln2"])
+        return x
+
+    # ------------------------------------------------------------------
+    def _init_decode_state(self, enc_out, src_mask, rows):
+        """Pre-compute cross K/V; allocate self-attn caches [rows,...]."""
+        reps = rows // enc_out.shape[0]
+        enc_out = jnp.repeat(enc_out, reps, axis=0)
+        src_mask = jnp.repeat(src_mask, reps, axis=0)
+        dk = self.d_model // self.n_head
+        state = {"cross_bias": (src_mask[:, None, None, :] - 1.0) * 1e9}
+        for i, p in enumerate(self.dec_layers):
+            ck, cv = self._kv(p["cross"], enc_out)
+            state["cross_k%d" % i], state["cross_v%d" % i] = ck, cv
+            state["k%d" % i] = jnp.zeros(
+                (rows, self.n_head, self.max_len, dk), enc_out.dtype)
+            state["v%d" % i] = jnp.zeros_like(state["k%d" % i])
+        return state
+
+    def _step_logits(self, tok, state, t):
+        """One incremental decode step: tok [rows] i32 → logits [rows, V]."""
+        x = self.trg_word_emb[tok] * (self.d_model ** 0.5) \
+            + self.trg_pos_emb[t]
+        x = x[:, None, :]                               # [rows, 1, D]
+        pos_mask = (jnp.arange(self.max_len) <= t)      # keys valid ≤ t
+        self_bias = jnp.where(pos_mask, 0.0, -1e9)[None, None, None, :]
+        for i, p in enumerate(self.dec_layers):
+            k_new, v_new = self._kv(p["self"], x)       # [rows, H, 1, dk]
+            k = lax.dynamic_update_slice_in_dim(state["k%d" % i], k_new, t,
+                                                axis=2)
+            v = lax.dynamic_update_slice_in_dim(state["v%d" % i], v_new, t,
+                                                axis=2)
+            state["k%d" % i], state["v%d" % i] = k, v
+            a = self._mha(p["self"], x, k, v, self_bias)
+            x = _ln(x + a, *p["ln1"])
+            c = self._mha(p["cross"], x, state["cross_k%d" % i],
+                          state["cross_v%d" % i], state["cross_bias"])
+            x = _ln(x + c, *p["ln2"])
+            x = _ln(x + self._ffn(p, x), *p["ln3"])
+        logits = x[:, 0, :] @ self.w_out
+        return logits, state
+
+    # ------------------------------------------------------------------
+    def translate(self, src_tokens, src_mask, beam_size=4, max_out_len=None,
+                  length_penalty=0.0):
+        """Beam-search translate. Returns (sentences [B, beam, T] — best
+        first, scores [B, beam])."""
+        max_out = self._check_out_len(max_out_len)
+        batch = src_tokens.shape[0]
+        enc = self.encode(src_tokens, src_mask)
+        state = self._init_decode_state(enc, src_mask, batch * beam_size)
+        return decoding.beam_search(self._step_logits, state, self.bos_id,
+                                    self.end_id, max_out, batch, beam_size,
+                                    length_penalty)
+
+    def _check_out_len(self, max_out_len):
+        max_out = max_out_len or self.max_len
+        if max_out > self.max_len:
+            # beyond max_len the pos-emb gather and KV-cache writes would
+            # silently clamp and corrupt the cache — fail loudly instead
+            raise ValueError(
+                "max_out_len %d exceeds the model's max_len %d"
+                % (max_out, self.max_len))
+        return max_out
+
+    def translate_greedy(self, src_tokens, src_mask, max_out_len=None):
+        max_out = self._check_out_len(max_out_len)
+        batch = src_tokens.shape[0]
+        enc = self.encode(src_tokens, src_mask)
+        state = self._init_decode_state(enc, src_mask, batch)
+        return decoding.greedy_search(self._step_logits, state, self.bos_id,
+                                      self.end_id, max_out, batch)

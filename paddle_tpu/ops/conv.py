@@ -64,7 +64,8 @@ def _conv_nd(ctx, op, ndim):
         lhs_dilation=(1,) * (ndim - 2), rhs_dilation=dilations,
         dimension_numbers=dn, feature_group_count=groups,
         preferred_element_type=pet)
-    ctx.set_out(op, "Output", out.astype(out_dtype))
+    from ..amp import amp_out
+    ctx.set_out(op, "Output", amp_out(out, out_dtype))
 
 
 @register("conv2d")
@@ -127,7 +128,8 @@ def _conv_transpose_nd(ctx, op, ndim):
             (0, max(0, int(s) - out.shape[2 + i]))
             for i, s in enumerate(out_size)]
         out = jnp.pad(out, pad)
-    ctx.set_out(op, "Output", out.astype(out_dtype))
+    from ..amp import amp_out
+    ctx.set_out(op, "Output", amp_out(out, out_dtype))
 
 
 @register("conv2d_transpose")

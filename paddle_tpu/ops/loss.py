@@ -24,6 +24,8 @@ def _gather_label_prob(x, label):
 @register("cross_entropy")
 def _cross_entropy(ctx, op):
     x = ctx.in1(op, "X")          # probabilities [N, C]
+    if x.dtype == jnp.bfloat16:   # AMP: loss math in fp32 (loss-scale-free)
+        x = x.astype(jnp.float32)
     label = ctx.in1(op, "Label")
     if x.shape[0] != label.shape[0]:
         raise ValueError(
@@ -52,6 +54,8 @@ def _cross_entropy(ctx, op):
 @register("softmax_with_cross_entropy")
 def _softmax_xent(ctx, op):
     logits = ctx.in1(op, "Logits")
+    if logits.dtype == jnp.bfloat16:   # AMP: loss math in fp32
+        logits = logits.astype(jnp.float32)
     label = ctx.in1(op, "Label")
     log_sm = jax.nn.log_softmax(logits, axis=-1)
     if op.attr("soft_label", False):

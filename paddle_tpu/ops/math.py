@@ -48,7 +48,8 @@ def _mul(ctx, op):
     x2, xshape = _flatten2d(x, xn)
     y2 = y.reshape(functools.reduce(lambda a, b: a * b, y.shape[:yn], 1), -1)
     out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
-    out = out.astype(out_dtype)
+    from ..amp import amp_out
+    out = amp_out(out, out_dtype)
     out = out.reshape(xshape[:xn] + y.shape[yn:])
     ctx.set_out(op, "Out", out)
 
@@ -64,7 +65,8 @@ def _matmul(ctx, op):
     if op.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    out = out.astype(out_dtype)
+    from ..amp import amp_out
+    out = amp_out(out, out_dtype)
     alpha = op.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

@@ -15,7 +15,9 @@ from ..core.registry import register
 @register("softmax")
 def _softmax(ctx, op):
     x = ctx.in1(op, "X")
-    ctx.set_out(op, "Out", jax.nn.softmax(x, axis=-1))
+    # AMP: exponentials/normalization in fp32, result back to input dtype
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    ctx.set_out(op, "Out", jax.nn.softmax(xf, axis=-1).astype(x.dtype))
 
 
 @register("log_softmax")
@@ -85,11 +87,14 @@ def _batch_norm(ctx, op):
     bshape = [1] * x.ndim
     bshape[ch_axis] = x.shape[ch_axis]
 
+    # stats in fp32 regardless of activation dtype (bf16 under AMP): a
+    # bf16 accumulation over B*H*W elements loses the mean entirely
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float64 else x
     if is_test:
         mean, var = mean_in, var_in
     else:
-        mean = jnp.mean(x, axis=reduce_axes)
-        var = jnp.var(x, axis=reduce_axes)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
         new_mean = momentum * mean_in + (1 - momentum) * mean
         new_var = momentum * var_in + (1 - momentum) * var
         ctx.set_out(op, "MeanOut", new_mean)
@@ -106,9 +111,10 @@ def _batch_norm(ctx, op):
             ctx.env[vin_names[0]] = jax.lax.stop_gradient(new_var)
 
     inv = jax.lax.rsqrt(var + eps)
-    out = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
     out = out * scale.reshape(bshape) + bias.reshape(bshape)
-    ctx.set_out(op, "Y", out)
+    # activations keep their incoming dtype (bf16 stays bf16 under AMP)
+    ctx.set_out(op, "Y", out.astype(x.dtype))
 
 
 @register("layer_norm")
@@ -119,14 +125,15 @@ def _layer_norm(ctx, op):
     eps = op.attr("epsilon", 1e-5)
     begin = op.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
     if scale is not None:
         out = out * scale.reshape((1,) * begin + x.shape[begin:])
     if bias is not None:
         out = out + bias.reshape((1,) * begin + x.shape[begin:])
-    ctx.set_out(op, "Y", out)
+    ctx.set_out(op, "Y", out.astype(x.dtype))
     ctx.set_out(op, "Mean", mean.reshape(x.shape[:begin]))
     ctx.set_out(op, "Variance", var.reshape(x.shape[:begin]))
 
