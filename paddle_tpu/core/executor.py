@@ -178,6 +178,7 @@ class Executor:
             return jax.random.fold_in(base_key, counter[0])
 
         ctx = registry.LowerContext(env, rng_fn, executor=self, block=block,
+                                    mesh=getattr(self, "_mesh", None),
                                     static_info=static_info)
         bwd_idx = None
         for i, o in enumerate(ops):
@@ -226,6 +227,7 @@ class Executor:
             env.update(feeds)
             ctx = registry.LowerContext(env, rng_fn, executor=self,
                                         block=block,
+                                        mesh=getattr(self, "_mesh", None),
                                         static_info=static_info)
             if bwd_idx is None:
                 for op in ops:
@@ -263,6 +265,7 @@ class Executor:
             fctx = registry.LowerContext(env, ctx._rng_fn,
                                          is_test=ctx.is_test,
                                          executor=ctx.executor, block=block,
+                                         mesh=ctx.mesh,
                                          static_info=ctx.static_info)
             for op in ops[:bwd_idx]:
                 _lower_op(fctx, op)

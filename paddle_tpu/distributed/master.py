@@ -1,0 +1,259 @@
+"""Elastic data master: task queue with timeout/retry + disk snapshot.
+
+Reference parity: go/master/service.go:49-56 (todo/pending/done/failed
+queues, per-task timeout, max-retry), go/master/client.go (trainer-side
+NextRecord loop). The Go master hands out file *chunks*; here a task is an
+opaque payload (e.g. a file path, a chunk index, a shard id) and trainers
+pull tasks, stream the records, and ack. At-least-once semantics: a trainer
+that dies mid-task never acks, the lease times out, and the task returns to
+todo (→ failed after max_retries). Every transition snapshots the queue
+state to disk with the atomic temp+fsync+rename pattern (io.py checkpoint
+parity), so a restarted master resumes where it stopped.
+
+The wire protocol reuses distributed/rpc.py's length-prefixed framing —
+verbs GETT / DONE / FAIL / PING / EXIT — instead of the reference's gRPC.
+"""
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+
+from .rpc import _send_msg, _recv_msg
+
+__all__ = ["TaskQueue", "MasterServer", "MasterClient"]
+
+
+class TaskQueue:
+    """In-process queue core (service.go taskQueues)."""
+
+    def __init__(self, payloads=(), timeout_s=10.0, max_retries=3,
+                 snapshot_path=None):
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.snapshot_path = snapshot_path
+        self._lock = threading.Lock()
+        self.todo = [{"id": i, "payload": p, "retries": 0}
+                     for i, p in enumerate(payloads)]
+        self.pending = {}    # id -> {task, owner, deadline}
+        self.done = []
+        self.failed = []
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._load()
+
+    # -- queue ops (all snapshot on transition) -------------------------------
+    def get_task(self, owner):
+        with self._lock:
+            self._requeue_expired()
+            if not self.todo:
+                return None
+            task = self.todo.pop(0)
+            self.pending[task["id"]] = {
+                "task": task, "owner": owner,
+                "deadline": time.time() + self.timeout_s}
+            self._snapshot()
+            return dict(task)
+
+    def task_done(self, task_id):
+        with self._lock:
+            ent = self.pending.pop(int(task_id), None)
+            if ent is not None:
+                self.done.append(ent["task"])
+                self._snapshot()
+                return True
+            return False
+
+    def task_failed(self, task_id):
+        with self._lock:
+            ent = self.pending.pop(int(task_id), None)
+            if ent is not None:
+                self._fail_or_retry(ent["task"])
+                self._snapshot()
+                return True
+            return False
+
+    def counts(self):
+        with self._lock:
+            self._requeue_expired()
+            return {"todo": len(self.todo), "pending": len(self.pending),
+                    "done": len(self.done), "failed": len(self.failed)}
+
+    def all_done(self):
+        c = self.counts()
+        return c["todo"] == 0 and c["pending"] == 0
+
+    # -- internals ------------------------------------------------------------
+    def _fail_or_retry(self, task):
+        task["retries"] += 1
+        if task["retries"] > self.max_retries:
+            self.failed.append(task)
+        else:
+            self.todo.append(task)
+
+    def _requeue_expired(self):
+        # caller holds the lock (service.go checkTimeoutFunc)
+        now = time.time()
+        expired = [tid for tid, e in self.pending.items()
+                   if e["deadline"] <= now]
+        for tid in expired:
+            ent = self.pending.pop(tid)
+            self._fail_or_retry(ent["task"])
+        if expired:
+            self._snapshot()
+
+    def _snapshot(self):
+        if not self.snapshot_path:
+            return
+        state = {"todo": self.todo,
+                 "pending": [e["task"] for e in self.pending.values()],
+                 "done": self.done, "failed": self.failed}
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snapshot_path)
+
+    def _load(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        # pending tasks had live leases when the master died: back to todo
+        self.todo = state["todo"] + state["pending"]
+        self.pending = {}
+        self.done = state["done"]
+        self.failed = state["failed"]
+
+
+class MasterServer:
+    """TCP face of a TaskQueue (service.go + RPC layer)."""
+
+    def __init__(self, queue, host="127.0.0.1", port=0, port_file=None):
+        self.queue = queue
+        self._shutdown = threading.Event()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, name, payload = _recv_msg(self.request)
+                        if not outer._dispatch(self.request, op, name,
+                                               payload):
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        if port_file:
+            with open(port_file, "w") as f:
+                f.write(str(self.port))
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._shutdown.set()
+        if self._thread.is_alive():
+            self._server.shutdown()
+        self._server.server_close()
+
+    def _dispatch(self, sock, op, name, payload):
+        if op == "GETT":
+            task = self.queue.get_task(owner=name)
+            if task is None:
+                done = self.queue.all_done()
+                _send_msg(sock, "NONE", "done" if done else "wait")
+            else:
+                _send_msg(sock, "TASK", str(task["id"]),
+                          json.dumps(task["payload"]).encode())
+        elif op == "DONE":
+            self.queue.task_done(name)
+            _send_msg(sock, "OK")
+        elif op == "FAIL":
+            self.queue.task_failed(name)
+            _send_msg(sock, "OK")
+        elif op == "PING":
+            _send_msg(sock, "OK", "",
+                      json.dumps(self.queue.counts()).encode())
+        elif op == "EXIT":
+            _send_msg(sock, "OK")
+            self.stop()
+            return False
+        else:
+            _send_msg(sock, "ERR", "unknown op %s" % op)
+        return True
+
+
+class MasterClient:
+    """Trainer-side client (go/master/client.go)."""
+
+    def __init__(self, endpoint, worker_id="trainer", timeout=30.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(timeout)
+        self.worker_id = worker_id
+
+    def get_task(self):
+        """Returns (task_id, payload) or (None, status): status 'done' when
+        the epoch is complete, 'wait' when tasks are pending elsewhere."""
+        _send_msg(self._sock, "GETT", self.worker_id)
+        op, name, payload = _recv_msg(self._sock)
+        if op == "NONE":
+            return None, name
+        return int(name), json.loads(payload.decode())
+
+    def task_done(self, task_id):
+        _send_msg(self._sock, "DONE", str(task_id))
+        assert _recv_msg(self._sock)[0] == "OK"
+
+    def task_failed(self, task_id):
+        _send_msg(self._sock, "FAIL", str(task_id))
+        assert _recv_msg(self._sock)[0] == "OK"
+
+    def counts(self):
+        _send_msg(self._sock, "PING", "")
+        op, _, payload = _recv_msg(self._sock)
+        return json.loads(payload.decode())
+
+    def shutdown_server(self):
+        try:
+            _send_msg(self._sock, "EXIT", "")
+            _recv_msg(self._sock)
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def records(self, load_fn, poll_s=0.05):
+        """Generator over all records of all tasks (client.go NextRecord):
+        pulls tasks until the master reports done, yields load_fn(payload)
+        items, acks on completion, reports failure on exception."""
+        while True:
+            task_id, payload = self.get_task()
+            if task_id is None:
+                if payload == "done":
+                    return
+                time.sleep(poll_s)
+                continue
+            try:
+                for rec in load_fn(payload):
+                    yield rec
+            except Exception:
+                self.task_failed(task_id)
+                raise
+            self.task_done(task_id)

@@ -12,6 +12,7 @@ deserializer.
 """
 
 import json
+import os
 import socket
 import socketserver
 import struct
@@ -220,6 +221,80 @@ class VariableServer:
                        and not self._shutdown.is_set()):
                     self._round_cv.wait(timeout=0.1)
         _send_msg(sock, "OK")
+
+
+    # -- checkpoint / recover (go/pserver/service.go:156-205,346) ------------
+    def checkpoint(self, path):
+        """Durably persist the parameter store. The blob goes to a
+        VERSIONED file (path.<round>) and the meta JSON — which names the
+        blob — is atomically renamed into place LAST, so a crash at any
+        point leaves the previous (meta, blob) pair fully recoverable.
+        Older blobs are pruned only after the new meta is durable."""
+        import io as _io
+        import json
+        import tempfile
+        import zlib
+
+        with self._lock:
+            arrays = {k: np.asarray(v) for k, v in self.store.items()}
+            round_no = self._round
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        base = os.path.basename(path)
+        os.makedirs(d, exist_ok=True)
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        data = buf.getvalue()
+        blob_name = "%s.%d" % (base, round_no)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, blob_name))
+        meta = {"round": round_no, "crc32": zlib.crc32(data),
+                "blob": blob_name, "names": sorted(arrays)}
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path + ".meta")
+        for n in os.listdir(d):
+            if n.startswith(base + ".") and n != blob_name \
+                    and not n.endswith((".meta", ".tmp")):
+                try:
+                    os.remove(os.path.join(d, n))
+                except OSError:
+                    pass
+        return meta
+
+    def recover(self, path):
+        """Reload a checkpoint written by checkpoint(); returns the round
+        number, or None when absent/corrupt (service.go recover path —
+        a corrupt file is skipped, not trusted). The CRC is checked on the
+        exact bytes that get loaded (no re-read TOCTOU)."""
+        import io as _io
+        import json
+        import zlib
+
+        if not os.path.exists(path + ".meta"):
+            return None
+        with open(path + ".meta") as f:
+            meta = json.load(f)
+        blob = os.path.join(os.path.dirname(os.path.abspath(path)) or ".",
+                            meta.get("blob", os.path.basename(path)))
+        if not os.path.exists(blob):
+            return None
+        with open(blob, "rb") as f:
+            data = f.read()
+        if zlib.crc32(data) != meta["crc32"]:
+            return None
+        with np.load(_io.BytesIO(data)) as loaded:
+            with self._lock:
+                for name in loaded.files:
+                    self.store[name] = loaded[name]
+                self._round = int(meta.get("round", 0))
+        return meta["round"]
 
 
 class RPCClient:

@@ -20,6 +20,9 @@ from .nn import (  # noqa: F401
 )
 from .ops import *  # noqa: F401,F403
 from .math_ops import scale  # noqa: F401
+from .parallel_layers import (  # noqa: F401
+    pipelined_decoder_stack, sequence_parallel_attention, sparse_moe,
+)
 from .sequence_layers import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from . import control_flow  # noqa: F401

@@ -1,0 +1,110 @@
+"""Layer DSL for SP / PP / EP (ops/parallel_ops.py lowerings).
+
+Makes the distributed subsystem reachable from fluid-style model code:
+
+    attn = layers.sequence_parallel_attention(q, k, v, causal=True)
+    out, aux = layers.sparse_moe(x, num_experts=8, d_inner=2048)
+    y = layers.pipelined_decoder_stack(x, n_layer=8, n_head=8, d_inner=2048)
+
+Each runs the distributed path when ParallelExecutor's mesh has the
+matching axis (sp / ep / pp) and an identical-math dense fallback
+otherwise, so programs stay testable single-device.
+"""
+
+import numpy as np
+
+from .layer_helper import LayerHelper
+from ..initializer import Normal, Constant
+from ..param_attr import ParamAttr
+
+__all__ = ["sequence_parallel_attention", "sparse_moe",
+           "pipelined_decoder_stack"]
+
+
+def sequence_parallel_attention(q, k, v, causal=False, variant="ring",
+                                scale=0.0, name=None):
+    """q/k/v: [B, H, T, dk] variables (T sharded on the sp mesh axis under
+    ParallelExecutor). Returns [B, H, T, dk]."""
+    helper = LayerHelper("sp_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    helper.append_op(
+        type="sp_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "variant": variant, "scale": scale})
+    return out
+
+
+def sparse_moe(x, num_experts, d_inner, capacity_factor=1.25,
+               param_attr=None, name=None):
+    """Switch-style MoE FFN over [B, T, D] (or [T, D]) input. Expert
+    weights are stacked [E, ...] and sharded on the ep mesh axis. Returns
+    (out, aux_loss) — add aux_loss (scaled) to the training cost."""
+    helper = LayerHelper("moe_ffn", param_attr=param_attr, name=name)
+    d = int(x.shape[-1])
+    gate = helper.create_parameter(helper.param_attr, shape=[d, num_experts],
+                                   dtype=x.dtype,
+                                   default_initializer=Normal(0., 0.02))
+    w_up = helper.create_parameter(
+        ParamAttr(name=helper.name + ".w_up"),
+        shape=[num_experts, d, d_inner], dtype=x.dtype,
+        default_initializer=Normal(0., d ** -0.5))
+    w_down = helper.create_parameter(
+        ParamAttr(name=helper.name + ".w_down"),
+        shape=[num_experts, d_inner, d], dtype=x.dtype,
+        default_initializer=Normal(0., d_inner ** -0.5))
+    # expert dim rides the ep axis
+    prog = helper.main_program
+    prog._sharding_hints[w_up.name] = ("ep", None, None)
+    prog._sharding_hints[w_down.name] = ("ep", None, None)
+
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    aux = helper.create_variable_for_type_inference("float32", shape=())
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [x], "GateW": [gate], "WUp": [w_up],
+                "WDown": [w_down]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"capacity_factor": capacity_factor})
+    return out, aux
+
+
+def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
+                            num_microbatches=0, name=None):
+    """L identical causal decoder layers with layer-stacked parameters
+    ([L, ...], leading dim sharded on the pp mesh axis → GPipe schedule
+    under ParallelExecutor; lax.scan over layers otherwise).
+    x: [B, T, D]. Returns [B, T, D]."""
+    helper = LayerHelper("pipeline_stack", name=name)
+    d = int(x.shape[-1])
+    L = int(n_layer)
+
+    def p(suffix, shape, init):
+        w = helper.create_parameter(ParamAttr(name=helper.name + suffix),
+                                    shape=list(shape), dtype=x.dtype,
+                                    default_initializer=init)
+        helper.main_program._sharding_hints[w.name] = \
+            ("pp",) + (None,) * (len(shape) - 1)
+        return w
+
+    std = d ** -0.5
+    params = {
+        "WQ": p(".wq", (L, d, d), Normal(0., std)),
+        "WK": p(".wk", (L, d, d), Normal(0., std)),
+        "WV": p(".wv", (L, d, d), Normal(0., std)),
+        "WO": p(".wo", (L, d, d), Normal(0., std)),
+        "LN1S": p(".ln1_s", (L, d), Constant(1.0)),
+        "LN1B": p(".ln1_b", (L, d), Constant(0.0)),
+        "W1": p(".w1", (L, d, d_inner), Normal(0., std)),
+        "B1": p(".b1", (L, d_inner), Constant(0.0)),
+        "W2": p(".w2", (L, d_inner, d), Normal(0., d_inner ** -0.5)),
+        "B2": p(".b2", (L, d), Constant(0.0)),
+        "LN2S": p(".ln2_s", (L, d), Constant(1.0)),
+        "LN2B": p(".ln2_b", (L, d), Constant(0.0)),
+    }
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(
+        type="pipeline_stack",
+        inputs=dict({"X": [x]}, **{s: [w] for s, w in params.items()}),
+        outputs={"Out": [out]},
+        attrs={"n_head": n_head, "num_microbatches": num_microbatches})
+    return out

@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     metrics_ops,
     nn,
     optimizer_ops,
+    parallel_ops,
     sequence_ops,
     tensor_ops,
 )

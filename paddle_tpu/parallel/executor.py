@@ -37,6 +37,7 @@ class ParallelExecutor:
                            else CPUPlace())
         self._exe._cache = {}
         self._exe._rng_counter = 0
+        self._exe._mesh = self.mesh   # lowerings (sp/pp/ep ops) read this
         self._cache = {}
         self._loss_name = loss_name
 

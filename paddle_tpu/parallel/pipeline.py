@@ -56,11 +56,14 @@ def _gpipe_sharded(params, xs, stage_fn, axis_name):
     return lax.psum(outputs, axis_name)
 
 
-def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp"):
+def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
+          batch_axis=None):
     """Run ``stage_fn(params_i, x)`` as an S-stage pipeline.
 
     stacked_params: pytree whose leaves have leading dim S (= mesh[axis]);
     microbatches:   [M, mb, ...] array of M microbatches.
+    batch_axis:     mesh axis the mb dim is data-sharded on (e.g. "dp"),
+                    None if replicated.
     Returns [M, mb, ...] outputs of the final stage.
     """
     s = mesh.shape[axis_name]
@@ -71,8 +74,10 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp"):
                 % (leaf.shape[0], s))
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    xspec = P(None, batch_axis)
     fn = shard_map(
         functools.partial(_gpipe_sharded, stage_fn=stage_fn,
                           axis_name=axis_name),
-        mesh=mesh, in_specs=(pspec, P()), out_specs=P(), check_vma=False)
+        mesh=mesh, in_specs=(pspec, xspec), out_specs=xspec,
+        check_vma=False)
     return fn(stacked_params, microbatches)

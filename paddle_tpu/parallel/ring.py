@@ -73,12 +73,14 @@ def _ring_attention_sharded(q, k, v, axis_name, causal, scale):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
+                   batch_axis=None):
     """q,k,v: [B, H, T, D] with T sharded on `axis_name`. Returns [B,H,T,D]
-    with the same sharding."""
+    with the same sharding. Pass batch_axis="dp" when the mesh also data-
+    parallelizes the batch dim, so shard_map doesn't gather it."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ring_attention_sharded, axis_name=axis_name,
                           causal=causal, scale=scale),
@@ -88,7 +90,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
 
 
 def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
-                      scale=None):
+                      scale=None, batch_axis=None):
     """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps the
     sharded axis from sequence to heads, runs full local attention, then
     swaps back. Better when H >= axis_size and T is moderate."""
@@ -111,7 +113,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
         o = jnp.einsum("bhqk,bhkd->bhqd", p, v2.astype(jnp.float32))
         return a2a(o.astype(q.dtype), 2, 1)
 
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)
