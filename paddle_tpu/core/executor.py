@@ -28,14 +28,13 @@ import jax
 import jax.numpy as jnp
 
 from . import registry
+from .enforce import EnforceError, op_error
 from .program import Program, Variable, default_main_program
 from .scope import Scope, global_scope
 from .places import CPUPlace, Place, _default_place
 from .lod import LoDTensor
 
-
-class _FetchEscape(Exception):
-    pass
+_NANGUARD = "__nanguard__"
 
 
 def as_numpy(value):
@@ -126,13 +125,14 @@ class Executor:
                                    scope, static_info, return_numpy)
 
         from ..amp import amp_enabled
+        check_nan = bool(os.environ.get("PADDLE_TPU_CHECK_NAN_INF"))
         key = (program, program._version, _feed_signature(feed_arrays),
-               fetch_names, state_keys, amp_enabled(),
+               fetch_names, state_keys, amp_enabled(), check_nan,
                tuple(sorted(static_info.items())))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             fn = self._build(program, tuple(sorted(feed_arrays)), fetch_names,
-                             state_keys, static_info)
+                             state_keys, static_info, check_nan=check_nan)
             entry = jax.jit(fn, donate_argnums=(0,))
             if use_program_cache:
                 self._cache[key] = entry
@@ -142,7 +142,7 @@ class Executor:
         self._rng_counter += 1
 
         with jax.default_device(self.place.jax_device()):
-            fetches, new_state = entry(state, feed_arrays, rng_key)
+            fetches, new_state, guards = entry(state, feed_arrays, rng_key)
 
         # Commit updated persistable state back to the scope.
         for n, v in new_state.items():
@@ -150,7 +150,8 @@ class Executor:
         # New persistable vars materialized by this run (e.g. startup program
         # initializers) are committed too — _build returns them in new_state.
 
-        if os.environ.get("PADDLE_TPU_CHECK_NAN_INF"):
+        if check_nan:
+            self._check_guards(guards)
             self._check_nan_inf(fetch_names, fetches)
 
         if return_numpy:
@@ -180,6 +181,7 @@ class Executor:
         ctx = registry.LowerContext(env, rng_fn, executor=self, block=block,
                                     mesh=getattr(self, "_mesh", None),
                                     static_info=static_info)
+        ctx.check_nan = bool(os.environ.get("PADDLE_TPU_CHECK_NAN_INF"))
         bwd_idx = None
         for i, o in enumerate(ops):
             if o.type in ("backward_marker", "calc_gradient_marker"):
@@ -194,6 +196,9 @@ class Executor:
         for n in persistable:
             if n in env:
                 scope.set(n, env[n])
+        if ctx.check_nan:
+            self._check_guards(
+                {k: v for k, v in env.items() if k.startswith(_NANGUARD)})
         fetches = [_fetch_from_env(env, n) for n in fetch_names]
         if return_numpy:
             return [as_numpy(v) for v in fetches]
@@ -201,7 +206,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _build(self, program, feed_names, fetch_names, state_keys,
-               static_info=None):
+               static_info=None, check_nan=False):
         """Build the pure step function for one (program, signature)."""
         static_info = static_info or {}
         block = program.global_block()
@@ -229,6 +234,7 @@ class Executor:
                                         block=block,
                                         mesh=getattr(self, "_mesh", None),
                                         static_info=static_info)
+            ctx.check_nan = check_nan
             if bwd_idx is None:
                 for op in ops:
                     _lower_op(ctx, op)
@@ -239,9 +245,11 @@ class Executor:
             new_state = {n: env[n] for n in state_keys if n in env}
             # newly-created persistable values (startup initializers)
             for n in persistable_names:
-                if n not in new_state and n in env:
+                if n not in new_state and n in env \
+                        and not n.startswith(_NANGUARD):
                     new_state[n] = env[n]
-            return fetches, new_state
+            guards = {k: v for k, v in env.items() if k.startswith(_NANGUARD)}
+            return fetches, new_state, guards
 
         return step
 
@@ -267,6 +275,7 @@ class Executor:
                                          executor=ctx.executor, block=block,
                                          mesh=ctx.mesh,
                                          static_info=ctx.static_info)
+            fctx.check_nan = getattr(ctx, "check_nan", False)
             for op in ops[:bwd_idx]:
                 _lower_op(fctx, op)
             # scalar objective: mean-reduce each target (loss is already
@@ -297,6 +306,17 @@ class Executor:
                 raise FloatingPointError(
                     "NaN/Inf detected in fetched var %r" % n)
 
+    @staticmethod
+    def _check_guards(guards):
+        """Report the FIRST (program-order) op output that went non-finite."""
+        bad = [k for k, ok in guards.items() if not bool(np.asarray(ok))]
+        if bad:
+            k = min(bad, key=lambda s: int(s[len(_NANGUARD):].split("|")[0]))
+            _, op_type, var = k[len(_NANGUARD):].split("|", 2)
+            raise FloatingPointError(
+                "NaN/Inf detected in output %r of op %r "
+                "(PADDLE_TPU_CHECK_NAN_INF)" % (var, op_type))
+
 
 def _lower_op(ctx, op):
     if op.type in ("feed", "fetch"):
@@ -307,8 +327,31 @@ def _lower_op(ctx, op):
         raise NotImplementedError(
             "no TPU lowering registered for op %r (registered: %d ops)"
             % (op.type, len(registry.registered_ops())))
-    info.lower(ctx, op)
+    try:
+        info.lower(ctx, op)
+    except EnforceError:
+        raise
+    except Exception as e:  # annotate with op context (enforce.h:203 parity)
+        raise op_error(op, ctx.env, e) from e
     _propagate_lod(ctx, op)
+    if getattr(ctx, "check_nan", False):
+        _record_nan_guards(ctx, op)
+
+
+def _record_nan_guards(ctx, op):
+    """FLAGS_check_nan_inf parity with the reference's EVERY-op-output scan
+    (framework/executor.cc:27-94): one cheap isfinite reduction per float
+    output, carried through the jitted step as extra scalar outputs under
+    reserved ``__nanguard__`` env names (so they also flow through the
+    value_and_grad aux in _lower_with_grad)."""
+    for name in op.output_names:
+        v = ctx.env.get(name)
+        dt = getattr(v, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            idx = getattr(ctx, "_nan_idx", 0)
+            ctx._nan_idx = idx + 1
+            ctx.env["%s%d|%s|%s" % (_NANGUARD, idx, op.type, name)] = \
+                jnp.isfinite(v).all()
 
 
 def _propagate_lod(ctx, op):

@@ -60,6 +60,20 @@ def convert_dtype(dtype):
     raise ValueError("unsupported dtype %r" % (dtype,))
 
 
+_X64_NARROW = {"int64": "int32", "uint64": "uint32", "float64": "float32"}
+
+
+def runtime_dtype(dtype):
+    """convert_dtype + explicit narrowing of 64-bit types to 32-bit when JAX
+    x64 mode is off (the TPU default) — same values JAX would truncate to,
+    but chosen deliberately instead of via a per-call UserWarning."""
+    import jax
+    name = convert_dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        name = _X64_NARROW.get(name, name)
+    return name
+
+
 class VarType:
     """Variable kinds — parity with framework.proto VarType (19 kinds; we keep
     the ones with runtime meaning on TPU)."""
@@ -325,16 +339,21 @@ class Block:
 
 
 def _infer_shape(block, op):
-    """Best-effort compile-time shape inference via the op registry
-    (parity with CompileTimeInferShapeContext, op_desc.cc)."""
+    """Compile-time shape inference via the op registry (parity with
+    CompileTimeInferShapeContext, op_desc.cc). A registered infer_shape that
+    fails raises an enforce-style error with the op's declared context —
+    never swallowed (lowering-time errors get the same treatment in
+    core/executor._lower_op)."""
     from . import registry
+    from .enforce import op_error
     info = registry.lookup(op.type)
     if info is None or info.infer_shape is None:
         return
     try:
         info.infer_shape(block, op)
-    except Exception:
-        pass  # runtime tracing will produce exact shapes anyway
+    except Exception as e:
+        declared = {n: v.shape for n, v in block.vars.items()}
+        raise op_error(op, declared, e, phase="shape inference") from e
 
 
 # --------------------------------------------------------------------------

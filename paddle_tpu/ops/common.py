@@ -1,0 +1,19 @@
+"""Shared dtype helpers for op lowerings.
+
+The reference emits int64 indices/counters (framework.proto INT64 defaults).
+On TPU with JAX x64 off those become int32; ``I64`` picks the effective
+dtype once so lowerings state the intent without tripping JAX's per-call
+truncation UserWarning.
+"""
+
+import jax.numpy as jnp
+
+from ..core.program import runtime_dtype
+
+
+def _eff(name):
+    return jnp.dtype(runtime_dtype(name))
+
+
+I64 = _eff("int64")
+F64 = _eff("float64")

@@ -13,12 +13,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.program import convert_dtype
+from ..core.program import convert_dtype, runtime_dtype
+from .common import I64
 from ..core.registry import register
 
 
 def _np_dtype(d):
-    return jnp.dtype(convert_dtype(d))
+    return jnp.dtype(runtime_dtype(d))
 
 
 @register("fill_constant", stateful_rng=False)
@@ -281,7 +282,7 @@ def _slice(ctx, op):
 @register("shape")
 def _shape(ctx, op):
     x = ctx.in1(op, "Input")
-    ctx.set_out(op, "Out", jnp.asarray(x.shape, dtype=jnp.int64))
+    ctx.set_out(op, "Out", jnp.asarray(x.shape, dtype=I64))
 
 
 @register("increment")

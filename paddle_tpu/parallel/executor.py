@@ -25,10 +25,31 @@ class ParallelExecutor:
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  share_vars_from=None, num_trainers=1, trainer_id=0,
                  mesh=None, scope=None, use_tpu=True, **kwargs):
+        # `use_cuda` is accepted as the reference's legacy "use accelerator"
+        # flag; device choice here is the mesh's. Anything we can't honor is
+        # rejected loudly instead of silently dropped.
+        if kwargs:
+            raise TypeError(
+                "unsupported ParallelExecutor arguments: %r"
+                % sorted(kwargs))
+        if num_trainers != 1 and jax.process_count() != num_trainers:
+            raise ValueError(
+                "num_trainers=%d but this process group has %d processes; "
+                "multi-trainer mode requires jax.distributed.initialize() "
+                "across exactly num_trainers hosts"
+                % (num_trainers, jax.process_count()))
+        self.num_trainers = num_trainers
+        self.trainer_id = trainer_id if num_trainers > 1 \
+            else jax.process_index()
         self.mesh = mesh or default_mesh() or make_mesh()
         if default_mesh() is None:
             set_default_mesh(self.mesh)
         self._program = main_program or default_main_program()
+        if share_vars_from is not None:
+            # reference semantics (parallel_executor.py share_vars_from):
+            # reuse the parameter scope of an existing executor (e.g. share
+            # train params with a test ParallelExecutor).
+            scope = share_vars_from._scope
         self._scope = scope or global_scope()
         self._exe = Executor.__new__(Executor)
         from ..core.places import TPUPlace, CPUPlace
@@ -129,7 +150,7 @@ class ParallelExecutor:
         feeds_dev = {k: jax.device_put(v, repl if k in lod_keys else data_sh)
                      for k, v in feed_arrays.items()}
 
-        fetches, new_state = entry(state_dev, feeds_dev, rng_key)
+        fetches, new_state, _guards = entry(state_dev, feeds_dev, rng_key)
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:

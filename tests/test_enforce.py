@@ -1,0 +1,55 @@
+"""Error-layer tests: enforce-style op context on lowering failures
+(enforce.h:203 parity) and the every-op-output NaN/Inf guard
+(framework/executor.cc:27-94 parity)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.enforce import EnforceError
+
+
+def test_lowering_error_carries_op_context():
+    a = fluid.layers.data("a", [4])
+    b = fluid.layers.data("b", [5])
+    # elementwise_add of incompatible shapes must fail with op context,
+    # not a raw JAX broadcast error.
+    c = fluid.layers.elementwise_add(a, b)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(EnforceError) as ei:
+        exe.run(feed={"a": np.ones((2, 4), np.float32),
+                      "b": np.ones((2, 5), np.float32)},
+                fetch_list=[c])
+    msg = str(ei.value)
+    assert "elementwise_add" in msg
+    assert "float32[2, 4]" in msg and "float32[2, 5]" in msg
+    assert "'a'" in msg and "'b'" in msg
+
+
+def test_nan_guard_catches_internal_nan(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "1")
+    x = fluid.layers.data("x", [3])
+    bad = fluid.layers.log(x)            # log of negative input -> NaN
+    good = fluid.layers.scale(x, 2.0)    # finite; the only fetched var
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(feed={"x": -np.ones((2, 3), np.float32)},
+                fetch_list=[good])
+    # the guard names the op that produced the NaN even though only the
+    # finite var was fetched
+    assert "log" in str(ei.value)
+    assert bad is not None
+
+
+def test_nan_guard_passes_finite_program(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "1")
+    x = fluid.layers.data("x", [3])
+    y = fluid.layers.fc(x, 2)
+    loss = fluid.layers.mean(y)
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={"x": np.ones((2, 3), np.float32)},
+                   fetch_list=[loss])
+    assert np.isfinite(out).all()
