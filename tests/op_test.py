@@ -1,0 +1,221 @@
+"""Generic OpTest harness — check_output / check_grad for any registered op.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py:212
+(`check_output_with_place` builds a one-op program and compares against
+numpy-computed expectations) and op_test.py:378 (`check_grad` compares
+analytic gradients against central finite differences, op_test.py:97
+`get_numeric_gradient`).
+
+Differences forced by the TPU design: the analytic gradient comes from
+`calc_gradient` (jax.value_and_grad over the traced lowering) instead of a
+per-op GradOpMaker, and everything runs through the compiled executor — so a
+grad check here exercises the *same* autodiff path training uses.
+
+Usage:
+    run_op("relu", {"X": x}, {}, ["Out"])                 -> {"Out": np...}
+    check_output("relu", {"X": x}, {}, {"Out": np.maximum(x, 0)})
+    check_grad("relu", {"X": x}, {}, wrt=["X"], out="Out")
+
+Input values may be np.ndarray, (np.ndarray, lod_lengths) tuples (fed as
+LoDTensor), or lists of np.ndarray for multi-var slots (concat/sum/stack).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _is_multi(val):
+    return isinstance(val, list)
+
+
+def _as_lod(val):
+    """(array, lengths) tuple -> LoDTensor feed; array -> plain feed."""
+    if isinstance(val, tuple):
+        arr, lengths = val
+        t = fluid.LoDTensor(np.asarray(arr))
+        t.set_recursive_sequence_lengths([list(lengths)])
+        return t
+    return np.asarray(val)
+
+
+def _declare(block, name, arr, lod_level=0):
+    a = np.asarray(arr[0] if isinstance(arr, tuple) else arr)
+    block.create_var(name=name, shape=a.shape, dtype=str(a.dtype),
+                     lod_level=lod_level, is_data=True)
+    return name
+
+
+def _build(op_type, inputs, attrs):
+    """Build a fresh one-op program. Returns (prog, feed, in_vars, out_map)."""
+    prog = fluid.Program()
+    block = prog.global_block()
+    feed = {}
+    in_map, in_vars = {}, {}
+    for slot, val in (inputs or {}).items():
+        if _is_multi(val):
+            names = []
+            for i, arr in enumerate(val):
+                nm = "%s_%d" % (slot.lower(), i)
+                _declare(block, nm, arr, lod_level=isinstance(arr, tuple))
+                feed[nm] = _as_lod(arr)
+                names.append(nm)
+            in_map[slot] = names
+            in_vars[slot] = [block.var(n) for n in names]
+        else:
+            nm = "in_" + slot.lower()
+            _declare(block, nm, val, lod_level=int(isinstance(val, tuple)))
+            feed[nm] = _as_lod(val)
+            in_map[slot] = [nm]
+            in_vars[slot] = block.var(nm)
+    return prog, block, feed, in_map, in_vars
+
+
+def run_op(op_type, inputs, attrs, out_slots, is_test=False, scope=None,
+           return_program=False):
+    """Execute one op; returns {out_slot: np.ndarray}."""
+    prog, block, feed, in_map, _ = _build(op_type, inputs, attrs)
+    out_map = {}
+    for slot in out_slots:
+        slot, n = slot if isinstance(slot, tuple) else (slot, 1)
+        names = ["out_%s_%d" % (slot.lower(), i) for i in range(n)]
+        for nm in names:
+            block.create_var(name=nm)
+        out_map[slot] = names
+    a = dict(attrs or {})
+    if is_test:
+        a.setdefault("is_test", True)
+    block.append_op(op_type, in_map, out_map, a)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = scope or fluid.Scope()
+    fetch, spans = [], []
+    for slot in out_slots:
+        slot, n = slot if isinstance(slot, tuple) else (slot, 1)
+        spans.append((slot, n, len(fetch)))
+        fetch.extend(out_map[slot])
+    with fluid.scope_guard(scope):
+        vals = exe.run(prog, feed=feed, fetch_list=fetch)
+    res = {s: (vals[i] if n == 1 else list(vals[i:i + n]))
+           for s, n, i in spans}
+    if return_program:
+        return res, (prog, block, feed, in_map, out_map, exe, scope)
+    return res
+
+
+def check_output(op_type, inputs, attrs, expected, rtol=1e-5, atol=1e-6,
+                 is_test=False):
+    """Compare op outputs against numpy expectations.
+
+    `expected`: dict out_slot -> array, or -> list of arrays for multi-var
+    output slots (split/unstack).
+    """
+    slots = [(s, len(w)) if isinstance(w, list) else s
+             for s, w in expected.items()]
+    got = run_op(op_type, inputs, attrs, slots, is_test=is_test)
+
+    def _cmp(slot, g, want):
+        want = np.asarray(want)
+        g = np.asarray(g)
+        assert g.shape == tuple(want.shape), \
+            "%s.%s: shape %s != expected %s" % (op_type, slot, g.shape,
+                                                want.shape)
+        if want.dtype.kind in "fc":
+            np.testing.assert_allclose(
+                g.astype(np.float64), want.astype(np.float64),
+                rtol=rtol, atol=atol,
+                err_msg="%s output %s" % (op_type, slot))
+        else:
+            np.testing.assert_array_equal(
+                g, want, err_msg="%s output %s" % (op_type, slot))
+
+    for slot, want in expected.items():
+        if isinstance(want, list):
+            for i, (g, w) in enumerate(zip(got[slot], want)):
+                _cmp("%s[%d]" % (slot, i), g, w)
+        else:
+            _cmp(slot, got[slot], want)
+    return got
+
+
+def check_grad(op_type, inputs, attrs, wrt, out="Out", out_slots=None,
+               delta=5e-3, rtol=5e-2, atol=5e-4, is_test=False):
+    """Analytic d(sum(out))/d(input) vs central finite differences.
+
+    `wrt` is a list of input slot names (single-var slots only). Matches the
+    reference's check_grad contract (op_test.py:378) with unit output
+    cotangents (sum-of-elements objective, see calc_gradient).
+    """
+    out_slots = out_slots or [out]
+    prog, block, feed, in_map, in_vars = _build(op_type, inputs, attrs)
+    out_map = {}
+    for slot in out_slots:
+        nm = "out_" + slot.lower()
+        block.create_var(name=nm)
+        out_map[slot] = [nm]
+    a = dict(attrs or {})
+    if is_test:
+        a.setdefault("is_test", True)
+    block.append_op(op_type, in_map, out_map, a)
+
+    target = block.var(out_map[out][0])
+    wrt_vars = [in_vars[s] for s in wrt]
+    with fluid.program_guard(prog):
+        fluid.calc_gradient([target], wrt_vars)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        analytic = exe.run(
+            prog, feed=feed,
+            fetch_list=[v.name + "@GRAD" for v in wrt_vars])
+
+        # forward-only evaluator for finite differences (fresh program so the
+        # grad marker is not re-traced per perturbation)
+        fprog, fblock, ffeed, fin_map, _ = _build(op_type, inputs, attrs)
+        fout_map = {}
+        for slot in out_slots:
+            nm = "out_" + slot.lower()
+            fblock.create_var(name=nm)
+            fout_map[slot] = [nm]
+        fblock.append_op(op_type, fin_map, fout_map, a)
+        fexe = fluid.Executor(fluid.CPUPlace())
+        fname = fout_map[out][0]
+
+        def fsum(feed_now):
+            v, = fexe.run(fprog, feed=feed_now, fetch_list=[fname])
+            return float(np.sum(np.asarray(v, np.float64)))
+
+        for slot, got in zip(wrt, analytic):
+            got = np.asarray(got, np.float64)
+            key = "in_" + slot.lower()
+            orig_feed = feed[key]
+            is_lod = isinstance(orig_feed, fluid.LoDTensor)
+            base_arr = np.asarray(orig_feed.data if is_lod else orig_feed)
+            base = base_arr.astype(np.float64)
+
+            def refeed(arr):
+                arr = arr.astype(base_arr.dtype)
+                if is_lod:
+                    return fluid.LoDTensor(arr, orig_feed.lod)
+                return arr
+
+            num = np.zeros_like(base).reshape(-1)
+            flat = base.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                f2 = dict(ffeed)
+                pert = base.copy().reshape(-1)
+                pert[i] = orig + delta
+                f2[key] = refeed(pert.reshape(base.shape))
+                hi = fsum(f2)
+                pert[i] = orig - delta
+                f2[key] = refeed(pert.reshape(base.shape))
+                lo = fsum(f2)
+                num[i] = (hi - lo) / (2 * delta)
+            num = num.reshape(base.shape)
+            denom = np.maximum(np.abs(num), np.abs(got))
+            bad = np.abs(num - got) > (atol + rtol * denom)
+            assert not bad.any(), (
+                "%s grad wrt %s mismatch at %d/%d elements\nanalytic=%s\n"
+                "numeric=%s" % (op_type, slot, bad.sum(), bad.size,
+                                got.reshape(-1)[:8], num.reshape(-1)[:8]))
+    return analytic

@@ -1,0 +1,794 @@
+"""Systematic op sweep, part 1: activations, elementwise, reductions, math,
+tensor manipulation, random, loss, and nn ops.
+
+Reference parity: the ~200 test_*_op.py files under
+python/paddle/fluid/tests/unittests/, driven by op_test.py:212 (output
+checks vs numpy) and op_test.py:378 (finite-difference gradient checks).
+Part 2 (optimizers, metrics, rnn cells, detection, 3-D conv/pool) is
+tests/test_ops_sweep2.py; the registry-completeness check lives there too.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import check_output, check_grad, run_op
+
+
+def _r(*shape, lo=0.0, hi=1.0, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(abs(hash((shape, lo, hi, seed))) % (2**31))
+    return (rng.uniform(lo, hi, size=shape)).astype(dtype)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _softplus(x):
+    return np.log1p(np.exp(x))
+
+
+def _erf(x):
+    import math
+    return np.vectorize(math.erf)(x).astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# activations (operators/activation_op.cc — 30 activations)
+# entry: (attrs, ref(x, attrs), domain (lo, hi), check_grad?)
+UNARY = {
+    "sigmoid": ({}, lambda x, a: _sigmoid(x), (-2, 2), True),
+    "logsigmoid": ({}, lambda x, a: -_softplus(-x), (-2, 2), True),
+    "exp": ({}, lambda x, a: np.exp(x), (-2, 2), True),
+    "relu": ({}, lambda x, a: np.maximum(x, 0), (0.1, 2), True),
+    "tanh": ({}, lambda x, a: np.tanh(x), (-2, 2), True),
+    "tanh_shrink": ({}, lambda x, a: x - np.tanh(x), (-2, 2), True),
+    "sqrt": ({}, lambda x, a: np.sqrt(x), (0.5, 4), True),
+    "rsqrt": ({}, lambda x, a: 1.0 / np.sqrt(x), (0.5, 4), True),
+    "abs": ({}, lambda x, a: np.abs(x), (0.3, 2), True),
+    "ceil": ({}, lambda x, a: np.ceil(x), (-2, 2), False),
+    "floor": ({}, lambda x, a: np.floor(x), (-2, 2), False),
+    "cos": ({}, lambda x, a: np.cos(x), (-2, 2), True),
+    "sin": ({}, lambda x, a: np.sin(x), (-2, 2), True),
+    "round": ({}, lambda x, a: np.round(x), (-2, 2), False),
+    "reciprocal": ({}, lambda x, a: 1.0 / x, (0.5, 3), True),
+    "log": ({}, lambda x, a: np.log(x), (0.5, 4), True),
+    "square": ({}, lambda x, a: x * x, (-2, 2), True),
+    "softplus": ({}, lambda x, a: _softplus(x), (-2, 2), True),
+    "softsign": ({}, lambda x, a: x / (1 + np.abs(x)), (0.2, 2), True),
+    "sign": ({}, lambda x, a: np.sign(x), (0.3, 2), False),
+    "gelu": ({}, lambda x, a: x * 0.5 * (1 + _erf(x / np.sqrt(2.0))),
+             (-2, 2), True),
+    "erf": ({}, lambda x, a: _erf(x), (-2, 2), True),
+    "silu": ({}, lambda x, a: x * _sigmoid(x), (-2, 2), True),
+    "brelu": ({"t_min": -0.5, "t_max": 0.8},
+              lambda x, a: np.clip(x, -0.5, 0.8), (-2, 2), False),
+    "leaky_relu": ({"alpha": 0.1},
+                   lambda x, a: np.where(x > 0, x, 0.1 * x), (0.2, 2), True),
+    "soft_relu": ({"threshold": 40.0},
+                  lambda x, a: np.log1p(np.exp(np.clip(x, -40, 40))),
+                  (-2, 2), True),
+    "elu": ({"alpha": 1.5},
+            lambda x, a: np.where(x > 0, x, 1.5 * (np.exp(x) - 1)),
+            (0.2, 2), True),
+    "relu6": ({"threshold": 6.0}, lambda x, a: np.clip(x, 0, 6.0),
+              (0.2, 2), True),
+    "pow": ({"factor": 3.0}, lambda x, a: x ** 3.0, (0.5, 2), True),
+    "stanh": ({"scale_a": 0.67, "scale_b": 1.7159},
+              lambda x, a: 1.7159 * np.tanh(0.67 * x), (-2, 2), True),
+    "hard_shrink": ({"threshold": 0.5},
+                    lambda x, a: np.where(np.abs(x) > 0.5, x, 0.0),
+                    (0.8, 2), False),
+    "softshrink": ({"lambda": 0.5},
+                   lambda x, a: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0),
+                   (0.8, 2), True),
+    "thresholded_relu": ({"threshold": 1.0},
+                         lambda x, a: np.where(x > 1.0, x, 0.0),
+                         (1.2, 2), True),
+    "hard_sigmoid": ({"slope": 0.2, "offset": 0.5},
+                     lambda x, a: np.clip(0.2 * x + 0.5, 0, 1),
+                     (-1.5, 1.5), False),
+    "swish": ({"beta": 1.5}, lambda x, a: x * _sigmoid(1.5 * x),
+              (-2, 2), True),
+    "mish": ({}, lambda x, a: x * np.tanh(_softplus(x)), (-2, 2), True),
+}
+
+
+@pytest.mark.parametrize("op", sorted(UNARY))
+def test_unary_output(op):
+    attrs, ref, (lo, hi), _ = UNARY[op]
+    x = _r(3, 4, lo=lo, hi=hi, seed=1)
+    check_output(op, {"X": x}, attrs, {"Out": ref(x, attrs)},
+                 rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "op", sorted(k for k, v in UNARY.items() if v[3]))
+def test_unary_grad(op):
+    attrs, _, (lo, hi), _ = UNARY[op]
+    x = _r(2, 3, lo=lo, hi=hi, seed=2).astype(np.float32)
+    check_grad(op, {"X": x}, attrs, wrt=["X"])
+
+
+def test_prelu():
+    x = _r(2, 4, lo=-2, hi=2, seed=3)
+    alpha = np.asarray([0.25], np.float32)
+    check_output("prelu", {"X": x, "Alpha": alpha}, {"mode": "all"},
+                 {"Out": np.where(x > 0, x, 0.25 * x)})
+
+
+# --------------------------------------------------------------------------
+# elementwise binary / compare / logical (operators/elementwise_*.cc)
+BINARY = {
+    "elementwise_add": (np.add, (-2, 2), True),
+    "elementwise_sub": (np.subtract, (-2, 2), True),
+    "elementwise_mul": (np.multiply, (-2, 2), True),
+    "elementwise_div": (np.divide, (0.5, 2), True),
+    "elementwise_max": (np.maximum, (-2, 2), False),
+    "elementwise_min": (np.minimum, (-2, 2), False),
+    "elementwise_pow": (np.power, (0.5, 2), True),
+}
+
+
+@pytest.mark.parametrize("op", sorted(BINARY))
+def test_binary_output(op):
+    fn, (lo, hi), _ = BINARY[op]
+    x = _r(3, 4, lo=lo, hi=hi, seed=4)
+    y = _r(3, 4, lo=lo, hi=hi, seed=5)
+    check_output(op, {"X": x, "Y": y}, {}, {"Out": fn(x, y)}, rtol=1e-4)
+
+
+@pytest.mark.parametrize("op", sorted(k for k, v in BINARY.items() if v[2]))
+def test_binary_grad(op):
+    _, (lo, hi), _ = BINARY[op]
+    x = _r(2, 3, lo=lo, hi=hi, seed=6)
+    y = _r(2, 3, lo=lo, hi=hi, seed=7)
+    check_grad(op, {"X": x, "Y": y}, {}, wrt=["X", "Y"])
+
+
+def test_elementwise_axis_broadcast():
+    # reference mid-dimension broadcast: Y [3] aligned to X [2,3,4] at axis=1
+    x = _r(2, 3, 4, seed=8)
+    y = _r(3, seed=9)
+    check_output("elementwise_add", {"X": x, "Y": y}, {"axis": 1},
+                 {"Out": x + y.reshape(1, 3, 1)})
+
+
+def test_elementwise_int_ops():
+    x = np.array([[7, 9], [4, 5]], np.int32)
+    y = np.array([[2, 4], [3, 2]], np.int32)
+    check_output("elementwise_mod", {"X": x, "Y": y}, {}, {"Out": x % y})
+    check_output("elementwise_floordiv", {"X": x, "Y": y}, {},
+                 {"Out": x // y})
+
+
+COMPARE = {
+    "less_than": np.less, "less_equal": np.less_equal,
+    "greater_than": np.greater, "greater_equal": np.greater_equal,
+    "equal": np.equal, "not_equal": np.not_equal,
+}
+
+
+@pytest.mark.parametrize("op", sorted(COMPARE))
+def test_compare_output(op):
+    x = np.array([[1, 2, 3], [4, 5, 6]], np.int32)
+    y = np.array([[1, 3, 2], [4, 4, 7]], np.int32)
+    check_output(op, {"X": x, "Y": y}, {}, {"Out": COMPARE[op](x, y)})
+
+
+LOGICAL = {"logical_and": np.logical_and, "logical_or": np.logical_or,
+           "logical_xor": np.logical_xor}
+
+
+@pytest.mark.parametrize("op", sorted(LOGICAL))
+def test_logical_output(op):
+    x = np.array([True, True, False, False])
+    y = np.array([True, False, True, False])
+    check_output(op, {"X": x, "Y": y}, {}, {"Out": LOGICAL[op](x, y)})
+
+
+def test_logical_not():
+    x = np.array([True, False])
+    check_output("logical_not", {"X": x}, {}, {"Out": ~x})
+
+
+# --------------------------------------------------------------------------
+# reductions (operators/reduce_op.cc)
+REDUCE = {
+    "reduce_sum": (np.sum, True), "reduce_mean": (np.mean, True),
+    "reduce_max": (np.max, True), "reduce_min": (np.min, False),
+    "reduce_prod": (np.prod, True),
+}
+
+
+@pytest.mark.parametrize("op", sorted(REDUCE))
+def test_reduce_output(op):
+    fn, _ = REDUCE[op]
+    x = _r(2, 3, 4, lo=0.5, hi=2, seed=10)
+    check_output(op, {"X": x}, {"dim": [1]}, {"Out": fn(x, axis=1)},
+                 rtol=1e-4)
+    check_output(op, {"X": x}, {"dim": [1], "keep_dim": True},
+                 {"Out": fn(x, axis=1, keepdims=True)}, rtol=1e-4)
+    check_output(op, {"X": x}, {"reduce_all": True},
+                 {"Out": np.asarray(fn(x))}, rtol=1e-4)
+    check_output(op, {"X": x}, {"dim": [-1]}, {"Out": fn(x, axis=-1)},
+                 rtol=1e-4)
+
+
+@pytest.mark.parametrize("op", sorted(k for k, v in REDUCE.items() if v[1]))
+def test_reduce_grad(op):
+    # distinct values keep max/min grads unambiguous
+    x = (np.arange(12, dtype=np.float32).reshape(3, 4) / 7.0) + 0.5
+    check_grad(op, {"X": x}, {"dim": [1]}, wrt=["X"])
+
+
+# --------------------------------------------------------------------------
+# core math (operators/{mul,matmul,sum,mean,scale,clip,...}_op.cc)
+def test_mul():
+    x, y = _r(3, 4, seed=11), _r(4, 5, seed=12)
+    check_output("mul", {"X": x, "Y": y}, {}, {"Out": x @ y}, rtol=1e-4)
+    check_grad("mul", {"X": x, "Y": y}, {}, wrt=["X", "Y"])
+
+
+def test_mul_num_col_dims():
+    x = _r(2, 3, 4, seed=13)   # x_num_col_dims=2 -> [6, 4]
+    y = _r(4, 5, seed=14)
+    want = (x.reshape(6, 4) @ y).reshape(2, 3, 5)
+    check_output("mul", {"X": x, "Y": y}, {"x_num_col_dims": 2},
+                 {"Out": want}, rtol=1e-4)
+
+
+def test_matmul_flags():
+    x, y = _r(3, 4, seed=15), _r(5, 4, seed=16)
+    check_output("matmul", {"X": x, "Y": y}, {"transpose_Y": True},
+                 {"Out": x @ y.T}, rtol=1e-4)
+    x2, y2 = _r(4, 3, seed=17), _r(4, 5, seed=18)
+    check_output("matmul", {"X": x2, "Y": y2}, {"transpose_X": True},
+                 {"Out": x2.T @ y2}, rtol=1e-4)
+    # batched + alpha
+    xb, yb = _r(2, 3, 4, seed=19), _r(2, 4, 5, seed=20)
+    check_output("matmul", {"X": xb, "Y": yb}, {"alpha": 2.0},
+                 {"Out": 2.0 * np.einsum("bij,bjk->bik", xb, yb)}, rtol=1e-4)
+
+
+def test_sum_multi_input():
+    xs = [_r(2, 3, seed=s) for s in (21, 22, 23)]
+    check_output("sum", {"X": xs}, {}, {"Out": xs[0] + xs[1] + xs[2]})
+
+
+def test_mean():
+    x = _r(3, 4, seed=24)
+    check_output("mean", {"X": x}, {}, {"Out": np.asarray(np.mean(x))})
+    check_grad("mean", {"X": x}, {}, wrt=["X"])
+
+
+def test_scale():
+    x = _r(3, 4, seed=25)
+    check_output("scale", {"X": x}, {"scale": 2.0, "bias": 1.0},
+                 {"Out": 2 * x + 1})
+    check_output("scale", {"X": x},
+                 {"scale": 2.0, "bias": 1.0, "bias_after_scale": False},
+                 {"Out": 2 * (x + 1)})
+
+
+def test_clip():
+    x = _r(3, 4, lo=-3, hi=3, seed=26)
+    check_output("clip", {"X": x}, {"min": -1.0, "max": 1.5},
+                 {"Out": np.clip(x, -1, 1.5)})
+
+
+def test_clip_by_norm():
+    x = _r(3, 4, lo=1, hi=2, seed=27)
+    n = np.sqrt((x ** 2).sum())
+    check_output("clip_by_norm", {"X": x}, {"max_norm": 1.0},
+                 {"Out": x / n}, rtol=1e-4)
+    check_output("clip_by_norm", {"X": x}, {"max_norm": float(n + 5)},
+                 {"Out": x})
+
+
+def test_cumsum():
+    x = _r(3, 4, seed=28)
+    check_output("cumsum", {"X": x}, {"axis": 1},
+                 {"Out": np.cumsum(x, axis=1)}, rtol=1e-4)
+    rev = np.flip(np.cumsum(np.flip(x, 1), axis=1), 1)
+    check_output("cumsum", {"X": x}, {"axis": 1, "reverse": True},
+                 {"Out": rev}, rtol=1e-4)
+
+
+def test_norm_ops():
+    x = _r(3, 4, lo=0.5, hi=2, seed=29)
+    check_output("l1_norm", {"X": x}, {},
+                 {"Out": np.asarray(np.abs(x).sum())}, rtol=1e-4)
+    check_output("squared_l2_norm", {"X": x}, {},
+                 {"Out": np.asarray((x ** 2).sum())}, rtol=1e-4)
+    check_grad("squared_l2_norm", {"X": x}, {}, wrt=["X"])
+    nrm = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    check_output("norm", {"X": x}, {"axis": 1, "epsilon": 1e-10},
+                 {"Out": x / nrm, "Norm": nrm}, rtol=1e-4)
+
+
+def test_squared_l2_distance():
+    x, y = _r(3, 4, seed=30), _r(3, 4, seed=31)
+    d = x - y
+    check_output("squared_l2_distance", {"X": x, "Y": y}, {},
+                 {"Out": (d ** 2).sum(1, keepdims=True), "sub_result": d},
+                 rtol=1e-4)
+    check_grad("squared_l2_distance", {"X": x, "Y": y}, {}, wrt=["X", "Y"])
+
+
+def test_cos_sim():
+    x, y = _r(3, 4, lo=0.5, hi=2, seed=32), _r(3, 4, lo=0.5, hi=2, seed=33)
+    xn = np.sqrt((x ** 2).sum(1, keepdims=True))
+    yn = np.sqrt((y ** 2).sum(1, keepdims=True))
+    want = (x * y).sum(1, keepdims=True) / (xn * yn + 1e-12)
+    check_output("cos_sim", {"X": x, "Y": y}, {},
+                 {"Out": want, "XNorm": xn, "YNorm": yn}, rtol=1e-4)
+    check_grad("cos_sim", {"X": x, "Y": y}, {}, wrt=["X", "Y"])
+
+
+def test_bilinear_tensor_product():
+    x, y = _r(3, 4, seed=34), _r(3, 5, seed=35)
+    w = _r(2, 4, 5, seed=36)
+    want = np.einsum("bm,omn,bn->bo", x, w, y)
+    check_output("bilinear_tensor_product",
+                 {"X": x, "Y": y, "Weight": w}, {}, {"Out": want}, rtol=1e-4)
+
+
+def test_top_k():
+    x = np.array([[0.1, 0.9, 0.5], [0.7, 0.2, 0.8]], np.float32)
+    got = run_op("top_k", {"X": x}, {"k": 2}, ["Out", "Indices"])
+    np.testing.assert_allclose(np.asarray(got["Out"]),
+                               [[0.9, 0.5], [0.8, 0.7]])
+    np.testing.assert_array_equal(np.asarray(got["Indices"]),
+                                  [[1, 2], [2, 0]])
+
+
+def test_arg_max_min():
+    x = _r(3, 5, seed=37)
+    check_output("arg_max", {"X": x}, {"axis": 1},
+                 {"Out": np.argmax(x, 1).astype(np.int32)})
+    check_output("arg_min", {"X": x}, {"axis": 0},
+                 {"Out": np.argmin(x, 0).astype(np.int32)})
+
+
+def test_minus():
+    x, y = _r(3, 4, seed=38), _r(3, 4, seed=39)
+    check_output("minus", {"X": x, "Y": y}, {}, {"Out": x - y})
+
+
+def test_conv_shift():
+    x, y = _r(2, 7, seed=40), _r(2, 3, seed=41)
+    m, n = 7, 3
+    half = n // 2
+    want = np.zeros_like(x)
+    for b in range(2):
+        for i in range(m):
+            for j in range(n):
+                want[b, i] += x[b, (i + j - half) % m] * y[b, j]
+    check_output("conv_shift", {"X": x, "Y": y}, {}, {"Out": want},
+                 rtol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# tensor manipulation (operators/{concat,split,reshape,...}_op.cc)
+def test_fill_constant():
+    check_output("fill_constant", {}, {"shape": [2, 3], "value": 1.5},
+                 {"Out": np.full((2, 3), 1.5, np.float32)})
+    check_output("fill_constant", {},
+                 {"shape": [2], "value": 3, "dtype": "int32"},
+                 {"Out": np.full((2,), 3, np.int32)})
+
+
+def test_fill_constant_batch_size_like():
+    ref = _r(5, 2, seed=42)
+    check_output("fill_constant_batch_size_like", {"Input": ref},
+                 {"shape": [3, 4], "value": 0.5},
+                 {"Out": np.full((5, 4), 0.5, np.float32)})
+
+
+def test_fill_like_ops():
+    x = _r(2, 3, seed=43)
+    check_output("fill_zeros_like", {"X": x}, {}, {"Out": np.zeros_like(x)})
+    check_output("fill_any_like", {"X": x}, {"value": 2.5},
+                 {"Out": np.full_like(x, 2.5)})
+
+
+def test_assign_ops():
+    x = _r(2, 3, seed=44)
+    check_output("assign", {"X": x}, {}, {"Out": x})
+    check_output("assign_value", {},
+                 {"shape": [2, 2], "values": [1.0, 2.0, 3.0, 4.0]},
+                 {"Out": np.array([[1, 2], [3, 4]], np.float32)})
+
+
+def test_cast():
+    x = _r(2, 3, lo=0, hi=5, seed=45)
+    check_output("cast", {"X": x}, {"out_dtype": "int32"},
+                 {"Out": x.astype(np.int32)})
+
+
+def test_concat():
+    xs = [_r(2, s, seed=46 + s) for s in (2, 3, 4)]
+    check_output("concat", {"X": xs}, {"axis": 1},
+                 {"Out": np.concatenate(xs, axis=1)})
+
+
+def test_split():
+    x = _r(2, 6, seed=50)
+    check_output("split", {"X": x}, {"axis": 1, "sections": [1, 2, 3]},
+                 {"Out": [x[:, :1], x[:, 1:3], x[:, 3:]]})
+    check_output("split", {"X": x}, {"axis": 1, "num": 3},
+                 {"Out": [x[:, :2], x[:, 2:4], x[:, 4:]]})
+
+
+def test_reshape_ops():
+    x = _r(2, 6, seed=51)
+    for op in ("reshape", "reshape2"):
+        check_output(op, {"X": x}, {"shape": [3, 4]},
+                     {"Out": x.reshape(3, 4)})
+        check_output(op, {"X": x}, {"shape": [-1, 2]},
+                     {"Out": x.reshape(6, 2)})
+
+
+def test_squeeze_unsqueeze():
+    x = _r(2, 1, 3, seed=52)
+    check_output("squeeze", {"X": x}, {"axes": [1]},
+                 {"Out": x.reshape(2, 3)})
+    y = _r(2, 3, seed=53)
+    check_output("unsqueeze", {"X": y}, {"axes": [1]},
+                 {"Out": y.reshape(2, 1, 3)})
+
+
+def test_transpose_ops():
+    x = _r(2, 3, 4, seed=54)
+    for op in ("transpose", "transpose2"):
+        check_output(op, {"X": x}, {"axis": [2, 0, 1]},
+                     {"Out": np.transpose(x, (2, 0, 1))})
+
+
+def test_expand():
+    x = _r(2, 3, seed=55)
+    check_output("expand", {"X": x}, {"expand_times": [2, 3]},
+                 {"Out": np.tile(x, (2, 3))})
+
+
+def test_stack_unstack():
+    xs = [_r(2, 3, seed=56 + i) for i in range(3)]
+    check_output("stack", {"X": xs}, {"axis": 1},
+                 {"Y": np.stack(xs, axis=1)})
+    x = np.stack(xs, axis=0)
+    check_output("unstack", {"X": x}, {"axis": 0}, {"Y": xs})
+
+
+def test_gather_scatter():
+    x = _r(5, 3, seed=60)
+    idx = np.array([0, 3, 1], np.int32)
+    check_output("gather", {"X": x, "Index": idx}, {}, {"Out": x[idx]})
+    check_grad("gather", {"X": x, "Index": idx}, {}, wrt=["X"])
+
+    upd = _r(2, 3, seed=61)
+    ids = np.array([1, 4], np.int32)
+    want = x.copy()
+    want[ids] = upd
+    check_output("scatter", {"X": x, "Ids": ids, "Updates": upd},
+                 {"overwrite": True}, {"Out": want})
+    want2 = x.copy()
+    want2[1] += upd[0]
+    want2[4] += upd[1]
+    check_output("scatter", {"X": x, "Ids": ids, "Updates": upd},
+                 {"overwrite": False}, {"Out": want2}, rtol=1e-5)
+
+
+def test_one_hot():
+    x = np.array([0, 2, 1], np.int32)
+    want = np.eye(4, dtype=np.float32)[x]
+    check_output("one_hot", {"X": x}, {"depth": 4}, {"Out": want})
+
+
+def test_pad_ops():
+    x = _r(2, 3, seed=62)
+    check_output("pad", {"X": x},
+                 {"paddings": [1, 0, 0, 2], "pad_value": 9.0},
+                 {"Out": np.pad(x, ((1, 0), (0, 2)), constant_values=9.0)})
+    big = _r(4, 5, seed=63)
+    small = _r(2, 3, seed=64)
+    check_output("pad_constant_like", {"X": big, "Y": small},
+                 {"pad_value": 0.0},
+                 {"Out": np.pad(small, ((0, 2), (0, 2)))})
+
+
+def test_crop():
+    x = _r(4, 5, seed=65)
+    check_output("crop", {"X": x}, {"offsets": [1, 2], "shape": [2, 2]},
+                 {"Out": x[1:3, 2:4]})
+
+
+def test_slice():
+    x = _r(4, 5, seed=66)
+    check_output("slice", {"Input": x},
+                 {"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]},
+                 {"Out": x[1:3, 0:4]})
+
+
+def test_shape_op():
+    x = _r(3, 7, seed=67)
+    check_output("shape", {"Input": x}, {},
+                 {"Out": np.array([3, 7], np.int32)})
+
+
+def test_increment():
+    x = np.array([3], np.int32)
+    check_output("increment", {"X": x}, {"step": 2.0},
+                 {"Out": np.array([5], np.int32)})
+
+
+def test_multiplex():
+    xs = [_r(3, 4, seed=70 + i) for i in range(2)]
+    ids = np.array([[1], [0], [1]], np.int32)
+    want = np.stack([xs[1][0], xs[0][1], xs[1][2]])
+    check_output("multiplex", {"X": xs, "Ids": ids}, {}, {"Out": want})
+
+
+def test_label_smooth():
+    x = np.eye(4, dtype=np.float32)[np.array([0, 2])]
+    eps = 0.1
+    check_output("label_smooth", {"X": x}, {"epsilon": eps},
+                 {"Out": (1 - eps) * x + eps / 4})
+
+
+def test_is_empty():
+    check_output("is_empty", {"X": np.zeros((0, 3), np.float32)}, {},
+                 {"Out": np.asarray(True)})
+    check_output("is_empty", {"X": np.zeros((2, 3), np.float32)}, {},
+                 {"Out": np.asarray(False)})
+
+
+def test_linspace():
+    check_output("linspace", {}, {"start": 0.0, "stop": 1.0, "num": 5},
+                 {"Out": np.linspace(0, 1, 5).astype(np.float32)})
+
+
+def test_sequence_mask_op():
+    x = np.array([2, 4, 1], np.int32)
+    want = (np.arange(5)[None, :] < x[:, None]).astype(np.float32)
+    check_output("sequence_mask", {"X": x}, {"maxlen": 5}, {"Y": want})
+
+
+def test_lookup_table():
+    w = _r(6, 4, seed=72)
+    ids = np.array([[1], [4], [2]], np.int64)
+    check_output("lookup_table", {"W": w, "Ids": ids}, {},
+                 {"Out": w[ids.reshape(-1)]})
+    # padding_idx rows read as zero
+    want = w[ids.reshape(-1)].copy()
+    want[1] = 0
+    check_output("lookup_table", {"W": w, "Ids": ids}, {"padding_idx": 4},
+                 {"Out": want})
+
+
+# --------------------------------------------------------------------------
+# random ops — distribution moments, not exact values
+def test_uniform_random():
+    got = run_op("uniform_random", {},
+                 {"shape": [4000], "min": -2.0, "max": 2.0}, ["Out"])
+    v = np.asarray(got["Out"])
+    assert v.shape == (4000,) and v.dtype == np.float32
+    assert v.min() >= -2 and v.max() <= 2
+    assert abs(v.mean()) < 0.15
+
+
+def test_gaussian_random():
+    got = run_op("gaussian_random", {},
+                 {"shape": [4000], "mean": 1.0, "std": 2.0}, ["Out"])
+    v = np.asarray(got["Out"])
+    assert abs(v.mean() - 1.0) < 0.2 and abs(v.std() - 2.0) < 0.2
+
+
+def test_truncated_gaussian_random():
+    got = run_op("truncated_gaussian_random", {},
+                 {"shape": [2000], "mean": 0.0, "std": 1.0}, ["Out"])
+    v = np.asarray(got["Out"])
+    assert np.abs(v).max() <= 2.0 + 1e-5
+
+
+def test_random_batch_size_like():
+    ref = _r(7, 2, seed=73)
+    for op in ("uniform_random_batch_size_like",
+               "gaussian_random_batch_size_like"):
+        got = run_op(op, {"Input": ref}, {"shape": [3, 5]}, ["Out"])
+        assert np.asarray(got["Out"]).shape == (7, 5)
+
+
+# --------------------------------------------------------------------------
+# losses (operators/*_loss_op.cc, cross_entropy, nce)
+def test_cross_entropy_hard():
+    p = _r(3, 4, lo=0.1, hi=1, seed=74)
+    p = p / p.sum(1, keepdims=True)
+    label = np.array([[0], [2], [1]], np.int64)
+    want = -np.log(p[np.arange(3), label.reshape(-1)]).reshape(3, 1)
+    check_output("cross_entropy", {"X": p, "Label": label}, {}, {"Y": want},
+                 rtol=1e-4)
+
+
+def test_cross_entropy_soft():
+    p = _r(3, 4, lo=0.1, hi=1, seed=75)
+    p = p / p.sum(1, keepdims=True)
+    lab = _r(3, 4, lo=0.1, hi=1, seed=76)
+    lab = lab / lab.sum(1, keepdims=True)
+    want = -(lab * np.log(p)).sum(1, keepdims=True)
+    check_output("cross_entropy", {"X": p, "Label": lab},
+                 {"soft_label": True}, {"Y": want}, rtol=1e-4)
+
+
+def test_softmax_with_cross_entropy():
+    logits = _r(3, 5, lo=-2, hi=2, seed=77)
+    label = np.array([[1], [0], [4]], np.int64)
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    sm = e / e.sum(1, keepdims=True)
+    want = -np.log(sm[np.arange(3), label.reshape(-1)]).reshape(3, 1)
+    check_output("softmax_with_cross_entropy",
+                 {"Logits": logits, "Label": label}, {},
+                 {"Loss": want, "Softmax": sm}, rtol=1e-4)
+    check_grad("softmax_with_cross_entropy",
+               {"Logits": logits, "Label": label}, {}, wrt=["Logits"],
+               out="Loss", out_slots=["Loss", "Softmax"])
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = _r(3, 4, lo=-2, hi=2, seed=78)
+    z = (_r(3, 4, seed=79) > 0.5).astype(np.float32)
+    want = np.maximum(x, 0) - x * z + np.log1p(np.exp(-np.abs(x)))
+    check_output("sigmoid_cross_entropy_with_logits",
+                 {"X": x, "Label": z}, {}, {"Out": want}, rtol=1e-4)
+    check_grad("sigmoid_cross_entropy_with_logits",
+               {"X": x, "Label": z}, {}, wrt=["X"])
+
+
+def test_hinge_loss():
+    logits = _r(4, 1, lo=-2, hi=2, seed=80)
+    labels = (_r(4, 1, seed=81) > 0.5).astype(np.float32)
+    want = np.maximum(0, 1 - (2 * labels - 1) * logits)
+    check_output("hinge_loss", {"Logits": logits, "Labels": labels}, {},
+                 {"Loss": want})
+
+
+def test_huber_loss():
+    x, y = _r(4, 1, seed=82), _r(4, 1, lo=0, hi=3, seed=83)
+    d = 1.0
+    r = y - x
+    want = np.where(np.abs(r) <= d, 0.5 * r * r, d * (np.abs(r) - 0.5 * d))
+    check_output("huber_loss", {"X": x, "Y": y}, {"delta": d},
+                 {"Out": want, "Residual": r}, rtol=1e-4)
+
+
+def test_log_loss():
+    p = _r(4, 1, lo=0.1, hi=0.9, seed=84)
+    lab = (_r(4, 1, seed=85) > 0.5).astype(np.float32)
+    eps = 1e-4
+    want = -lab * np.log(p + eps) - (1 - lab) * np.log(1 - p + eps)
+    check_output("log_loss", {"Predicted": p, "Labels": lab},
+                 {"epsilon": eps}, {"Loss": want}, rtol=1e-4)
+
+
+def test_smooth_l1_loss():
+    x, y = _r(3, 4, seed=86), _r(3, 4, seed=87)
+    d = x - y
+    elem = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+    want = elem.sum(1, keepdims=True)
+    check_output("smooth_l1_loss", {"X": x, "Y": y}, {"sigma": 1.0},
+                 {"Out": want, "Diff": d}, rtol=1e-4)
+
+
+def test_rank_loss():
+    lab = (_r(4, 1, seed=88) > 0.5).astype(np.float32)
+    left, right = _r(4, 1, seed=89), _r(4, 1, seed=90)
+    d = left - right
+    want = np.maximum(d, 0) - d * lab + np.log1p(np.exp(-np.abs(d)))
+    check_output("rank_loss",
+                 {"Label": lab, "Left": left, "Right": right}, {},
+                 {"Out": want}, rtol=1e-4)
+
+
+def test_margin_rank_loss():
+    lab = np.sign(_r(4, 1, lo=-1, hi=1, seed=91)).astype(np.float32)
+    x1, x2 = _r(4, 1, seed=92), _r(4, 1, seed=93)
+    want = np.maximum(0, -lab * (x1 - x2) + 0.1)
+    check_output("margin_rank_loss",
+                 {"Label": lab, "X1": x1, "X2": x2}, {"margin": 0.1},
+                 {"Out": want}, rtol=1e-4)
+
+
+def test_modified_huber_loss():
+    x = _r(4, 1, lo=-2, hi=2, seed=94)
+    y = (_r(4, 1, seed=95) > 0.5).astype(np.float32)
+    z = (2 * y - 1) * x
+    want = np.where(z < -1, -4 * z, np.maximum(0, 1 - z) ** 2)
+    check_output("modified_huber_loss", {"X": x, "Y": y}, {},
+                 {"Out": want, "IntermediateVal": z}, rtol=1e-4)
+
+
+def test_nce_shapes():
+    # stochastic negatives: check shape + positivity, not exact values
+    x = _r(4, 3, seed=96)
+    label = np.array([[1], [0], [2], [1]], np.int64)
+    w, b = _r(5, 3, seed=97), _r(5, seed=98)
+    got = run_op("nce", {"Input": x, "Label": label, "Weight": w, "Bias": b},
+                 {"num_neg_samples": 3, "num_total_classes": 5},
+                 ["Cost", "SampleLogits", "SampleLabels"])
+    cost = np.asarray(got["Cost"])
+    assert cost.shape == (4, 1) and (cost > 0).all()
+    assert np.asarray(got["SampleLogits"]).shape == (4, 4)
+
+
+# --------------------------------------------------------------------------
+# nn ops (softmax/dropout/batch_norm/layer_norm/lrn/maxout)
+def test_softmax_ops():
+    x = _r(3, 5, lo=-2, hi=2, seed=99)
+    e = np.exp(x - x.max(1, keepdims=True))
+    sm = e / e.sum(1, keepdims=True)
+    check_output("softmax", {"X": x}, {}, {"Out": sm}, rtol=1e-4)
+    check_output("log_softmax", {"X": x}, {}, {"Out": np.log(sm)},
+                 rtol=1e-4)
+    check_grad("softmax", {"X": x}, {}, wrt=["X"])
+
+
+def test_dropout():
+    x = _r(4, 5, lo=1, hi=2, seed=100)
+    # is_test -> identity under upscale_in_train
+    check_output("dropout", {"X": x},
+                 {"dropout_prob": 0.5,
+                  "dropout_implementation": "upscale_in_train"},
+                 {"Out": x}, is_test=True)
+    # train mode: Out = X * Mask / keep; Mask in {0,1}
+    got = run_op("dropout", {"X": _r(100, 10, lo=1, hi=2, seed=101)},
+                 {"dropout_prob": 0.3,
+                  "dropout_implementation": "upscale_in_train"},
+                 ["Out", "Mask"])
+    mask = np.asarray(got["Mask"])
+    assert set(np.unique(mask)).issubset({0.0, 1.0})
+    assert abs(mask.mean() - 0.7) < 0.05
+
+
+def test_batch_norm_inference():
+    x = _r(2, 3, 4, 4, seed=102)
+    scale, bias = _r(3, seed=103), _r(3, seed=104)
+    mean, var = _r(3, seed=105), _r(3, lo=0.5, hi=1.5, seed=106)
+    eps = 1e-5
+    want = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + eps)
+    want = want * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+    check_output("batch_norm",
+                 {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                  "Variance": var},
+                 {"epsilon": eps, "data_layout": "NCHW"},
+                 {"Y": want}, rtol=1e-4, atol=1e-5, is_test=True)
+
+
+def test_layer_norm():
+    x = _r(3, 8, seed=107)
+    scale, bias = _r(8, seed=108), _r(8, seed=109)
+    mu = x.mean(1, keepdims=True)
+    var = x.var(1, keepdims=True)
+    want = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+    check_output("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+                 {"epsilon": 1e-5, "begin_norm_axis": 1},
+                 {"Y": want}, rtol=1e-4, atol=1e-5)
+
+
+def test_lrn():
+    x = _r(2, 6, 3, 3, seed=110)
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    sq = x ** 2
+    mid = np.full_like(x, k)
+    half = n // 2
+    for c in range(6):
+        lo, hi = max(0, c - half), min(6, c + half + 1)
+        mid[:, c] += alpha * sq[:, lo:hi].sum(axis=1)
+    check_output("lrn", {"X": x},
+                 {"n": n, "k": k, "alpha": alpha, "beta": beta},
+                 {"Out": x / mid ** beta, "MidOut": mid}, rtol=1e-4)
+
+
+def test_maxout():
+    x = _r(2, 6, 3, 3, seed=111)
+    want = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    check_output("maxout", {"X": x}, {"groups": 2}, {"Out": want})
