@@ -37,6 +37,14 @@ from .lod import LoDTensor
 _NANGUARD = "__nanguard__"
 
 
+def _flag_on(name):
+    """Env-flag parsing with gflags semantics: '0'/'false'/'' mean OFF
+    (the reference's FLAGS_check_nan_inf=0 disables the check; a bare
+    bool() would read '0' as enabled)."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "off", "no")
+
+
 def as_numpy(value):
     """Convert a fetched value (jax.Array / LoDTensor / list) to numpy."""
     from .selected_rows import SelectedRows
@@ -125,7 +133,7 @@ class Executor:
                                    scope, static_info, return_numpy)
 
         from ..amp import amp_enabled
-        check_nan = bool(os.environ.get("PADDLE_TPU_CHECK_NAN_INF"))
+        check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
         key = (program, program._version, _feed_signature(feed_arrays),
                fetch_names, state_keys, amp_enabled(), check_nan,
                tuple(sorted(static_info.items())))
@@ -181,7 +189,7 @@ class Executor:
         ctx = registry.LowerContext(env, rng_fn, executor=self, block=block,
                                     mesh=getattr(self, "_mesh", None),
                                     static_info=static_info)
-        ctx.check_nan = bool(os.environ.get("PADDLE_TPU_CHECK_NAN_INF"))
+        ctx.check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
         bwd_idx = None
         for i, o in enumerate(ops):
             if o.type in ("backward_marker", "calc_gradient_marker"):
@@ -290,6 +298,12 @@ class Executor:
         (loss_val, env_after), grads = jax.value_and_grad(
             forward, has_aux=True)(wrt)
         ctx.env.update(env_after)
+        # continue the NaN-guard program-order index past the forward ops
+        # (the forward fctx numbered its guards from 0; optimizer-op guards
+        # recorded on `ctx` must sort after them, executor.cc:27-94 parity)
+        fwd_guard_idx = [int(k[len(_NANGUARD):].split("|", 1)[0])
+                         for k in env_after if k.startswith(_NANGUARD)]
+        ctx._nan_idx = max(fwd_guard_idx, default=-1) + 1
         if marker.type == "backward_marker":
             ctx.env[target_names[0] + "@GRAD"] = jnp.ones_like(loss_val)
         for p, g in grads.items():
@@ -309,6 +323,9 @@ class Executor:
     @staticmethod
     def _check_guards(guards):
         """Report the FIRST (program-order) op output that went non-finite."""
+        if not guards:
+            return
+        guards = jax.device_get(guards)  # one transfer for all guard scalars
         bad = [k for k, ok in guards.items() if not bool(np.asarray(ok))]
         if bad:
             k = min(bad, key=lambda s: int(s[len(_NANGUARD):].split("|")[0]))

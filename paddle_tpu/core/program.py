@@ -352,8 +352,10 @@ def _infer_shape(block, op):
     try:
         info.infer_shape(block, op)
     except Exception as e:
-        declared = {n: v.shape for n, v in block.vars.items()}
-        raise op_error(op, declared, e, phase="shape inference") from e
+        # pass Variables (shape+dtype attrs) so op_error prints real dims,
+        # not a bare tuple's "list[rank]" rendering
+        raise op_error(op, dict(block.vars), e, phase="shape inference") \
+            from e
 
 
 # --------------------------------------------------------------------------

@@ -1,9 +1,11 @@
 """Shared dtype helpers for op lowerings.
 
 The reference emits int64 indices/counters (framework.proto INT64 defaults).
-On TPU with JAX x64 off those become int32; ``I64`` picks the effective
-dtype once so lowerings state the intent without tripping JAX's per-call
-truncation UserWarning.
+On TPU with JAX x64 off those become int32; ``I64()`` picks the effective
+dtype at lowering time so lowerings state the intent without tripping JAX's
+per-call truncation UserWarning — and stay consistent with runtime_dtype
+(which fill_constant etc. consult per call) even if ``jax_enable_x64`` is
+toggled after import.
 """
 
 import jax.numpy as jnp
@@ -11,9 +13,9 @@ import jax.numpy as jnp
 from ..core.program import runtime_dtype
 
 
-def _eff(name):
-    return jnp.dtype(runtime_dtype(name))
+def I64():  # noqa: N802 — reads as the dtype constant it stands for
+    return jnp.dtype(runtime_dtype("int64"))
 
 
-I64 = _eff("int64")
-F64 = _eff("float64")
+def F64():  # noqa: N802
+    return jnp.dtype(runtime_dtype("float64"))

@@ -220,7 +220,7 @@ def _lod_array_length(ctx, op):
     arr_name = op.input("X")[0]
     lst = ctx.env.get(arr_name + "@ARRAY")
     n = len(lst) if lst is not None else ctx.get(arr_name).shape[0]
-    ctx.set_out(op, "Out", jnp.asarray([n], I64))
+    ctx.set_out(op, "Out", jnp.asarray([n], I64()))
 
 
 @register("shrink_rnn_memory")
@@ -233,7 +233,7 @@ def _shrink_rnn_memory(ctx, op):
 @register("max_sequence_len")
 def _max_sequence_len(ctx, op):
     lens = ctx.in1(op, "RankTable")
-    ctx.set_out(op, "Out", jnp.max(lens).reshape(1).astype(I64))
+    ctx.set_out(op, "Out", jnp.max(lens).reshape(1).astype(I64()))
 
 
 @register("lod_rank_table")

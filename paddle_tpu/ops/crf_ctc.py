@@ -139,7 +139,7 @@ def _crf_decoding(ctx, op):
     # sequence. Reconstruct: for each b, the decode of position t is valid
     # for t < len.
     out = _unpad(path[:, :, None], lens, total)
-    ctx.set_out(op, "ViterbiPath", out.astype(I64))
+    ctx.set_out(op, "ViterbiPath", out.astype(I64()))
 
 
 @register("warpctc")
@@ -224,7 +224,7 @@ def _ctc_align(ctx, op):
     new_lens = jax.ops.segment_sum(keep.astype(jnp.int32), seg,
                                    num_segments=lens.shape[0])
     name = ctx.out_name(op, "Output")
-    ctx.env[name] = out[:, None].astype(I64)
+    ctx.env[name] = out[:, None].astype(I64())
     ctx.env[name + "@LOD"] = new_lens
 
 
@@ -303,8 +303,8 @@ def _chunk_eval(ctx, op):
     ctx.set_out(op, "Recall", recall.reshape(1))
     ctx.set_out(op, "F1-Score", f1.reshape(1))
     ctx.set_out(op, "NumInferChunks",
-                num_inf.reshape(1).astype(I64))
+                num_inf.reshape(1).astype(I64()))
     ctx.set_out(op, "NumLabelChunks",
-                num_lab.reshape(1).astype(I64))
+                num_lab.reshape(1).astype(I64()))
     ctx.set_out(op, "NumCorrectChunks",
-                correct.reshape(1).astype(I64))
+                correct.reshape(1).astype(I64()))

@@ -209,19 +209,19 @@ def _top_k(ctx, op):
     k = op.attr("k", 1)
     vals, idx = lax.top_k(x, k)
     ctx.set_out(op, "Out", vals)
-    ctx.set_out(op, "Indices", idx.astype(I64))
+    ctx.set_out(op, "Indices", idx.astype(I64()))
 
 
 @register("arg_max")
 def _arg_max(ctx, op):
     ctx.set_out(op, "Out", jnp.argmax(
-        ctx.in1(op, "X"), axis=op.attr("axis", -1)).astype(I64))
+        ctx.in1(op, "X"), axis=op.attr("axis", -1)).astype(I64()))
 
 
 @register("arg_min")
 def _arg_min(ctx, op):
     ctx.set_out(op, "Out", jnp.argmin(
-        ctx.in1(op, "X"), axis=op.attr("axis", -1)).astype(I64))
+        ctx.in1(op, "X"), axis=op.attr("axis", -1)).astype(I64()))
 
 
 @register("minus")

@@ -17,8 +17,8 @@ def _accuracy(ctx, op):
     if label.ndim == 2 and label.shape[-1] == 1:
         label = label.reshape(-1)
     hit = jnp.any(indices == label[:, None].astype(indices.dtype), axis=1)
-    correct = jnp.sum(hit.astype(I64))
-    total = jnp.asarray(label.shape[0], I64)
+    correct = jnp.sum(hit.astype(I64()))
+    total = jnp.asarray(label.shape[0], I64())
     ctx.set_out(op, "Accuracy",
                 (correct.astype(jnp.float32) / total.astype(jnp.float32)
                  ).reshape(1))
@@ -126,4 +126,4 @@ def _edit_distance(ctx, op):
     if op.attr("normalized", True):
         dists = dists / jnp.maximum(rls.astype(jnp.float32), 1.0)
     ctx.set_out(op, "Out", dists.reshape(-1, 1))
-    ctx.set_out(op, "SequenceNum", jnp.asarray([hyp.shape[0]], I64))
+    ctx.set_out(op, "SequenceNum", jnp.asarray([hyp.shape[0]], I64()))

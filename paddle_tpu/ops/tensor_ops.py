@@ -282,7 +282,7 @@ def _slice(ctx, op):
 @register("shape")
 def _shape(ctx, op):
     x = ctx.in1(op, "Input")
-    ctx.set_out(op, "Out", jnp.asarray(x.shape, dtype=I64))
+    ctx.set_out(op, "Out", jnp.asarray(x.shape, dtype=I64()))
 
 
 @register("increment")

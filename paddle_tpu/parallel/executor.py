@@ -118,13 +118,16 @@ class ParallelExecutor:
 
         hints = tuple(sorted(
             (k, tuple(v)) for k, v in program._sharding_hints.items()))
+        from ..core.executor import _flag_on
+        check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
         key = (program, program._version, _feed_signature(feed_arrays),
-               fetch_names, state_keys, hints)
+               fetch_names, state_keys, hints, check_nan)
         entry = self._cache.get(key)
         repl = NamedSharding(self.mesh, PartitionSpec())
         if entry is None:
             fn = self._exe._build(program, tuple(sorted(feed_arrays)),
-                                  fetch_names, state_keys)
+                                  fetch_names, state_keys,
+                                  check_nan=check_nan)
             data_sh = self._data_sharding()
             state_sh = {n: self._state_sharding(n) for n in state_keys}
             in_shardings = (state_sh,
@@ -150,9 +153,12 @@ class ParallelExecutor:
         feeds_dev = {k: jax.device_put(v, repl if k in lod_keys else data_sh)
                      for k, v in feed_arrays.items()}
 
-        fetches, new_state, _guards = entry(state_dev, feeds_dev, rng_key)
+        fetches, new_state, guards = entry(state_dev, feeds_dev, rng_key)
         for n, v in new_state.items():
             scope.set(n, v)
+        if check_nan:
+            Executor._check_guards(guards)
+            Executor._check_nan_inf(fetch_names, fetches)
         if return_numpy:
             return [as_numpy(v) for v in fetches]
         return list(fetches)

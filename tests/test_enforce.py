@@ -53,3 +53,48 @@ def test_nan_guard_passes_finite_program(monkeypatch):
     out, = exe.run(feed={"x": np.ones((2, 3), np.float32)},
                    fetch_list=[loss])
     assert np.isfinite(out).all()
+
+
+def test_nan_guard_flag_zero_means_off(monkeypatch):
+    # gflags semantics: FLAGS_check_nan_inf=0 disables the check
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "0")
+    x = fluid.layers.data("x", [3])
+    fluid.layers.log(x)                  # NaN on negative input
+    good = fluid.layers.scale(x, 2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(feed={"x": -np.ones((2, 3), np.float32)},
+                   fetch_list=[good])    # must NOT raise
+    assert np.isfinite(out).all()
+
+
+def test_nan_guard_orders_forward_before_optimizer(monkeypatch):
+    # the FIRST reported op must be the forward op that produced the NaN,
+    # not the optimizer op the NaN propagated into (guard-index continuity
+    # across the backward marker)
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "1")
+    x = fluid.layers.data("x", [3])
+    y = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(
+        initializer=fluid.initializer.Constant(1.0)))
+    bad = fluid.layers.log(y)            # y < 0 for negative x -> NaN
+    loss = fluid.layers.mean(bad)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(feed={"x": -np.ones((4, 3), np.float32) * 10},
+                fetch_list=[loss])
+    assert "'log'" in str(ei.value) or "log" in str(ei.value)
+    assert "sgd" not in str(ei.value)
+
+
+def test_nan_guard_honored_by_parallel_executor(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "1")
+    from paddle_tpu import parallel
+    x = fluid.layers.data("x", [4])
+    bad = fluid.layers.log(x)
+    good = fluid.layers.scale(x, 2.0)
+    mesh = parallel.make_mesh({"dp": 2})
+    pexe = fluid.ParallelExecutor(mesh=mesh)
+    with pytest.raises(FloatingPointError):
+        pexe.run([good], feed={"x": -np.ones((4, 4), np.float32)})
+    assert bad is not None
