@@ -37,12 +37,54 @@ from .lod import LoDTensor
 _NANGUARD = "__nanguard__"
 
 
-def _flag_on(name):
-    """Env-flag parsing with gflags semantics: '0'/'false'/'' mean OFF
-    (the reference's FLAGS_check_nan_inf=0 disables the check; a bare
-    bool() would read '0' as enabled)."""
-    return os.environ.get(name, "").strip().lower() not in (
-        "", "0", "false", "off", "no")
+def _flag_on(name, default=False):
+    """Env-flag parsing with gflags semantics: '0'/'false'/'off'/'no' mean
+    OFF regardless of case; unset/empty means `default` (a bare bool()
+    would read '0' as enabled)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
+def _normalize_feeds(feed):
+    """LoDTensor/array feeds → (feed_arrays, static_info).
+
+    Sequence (LoD) feeds become FLAT row buffers + ``<name>@LOD`` length
+    vectors, with the flat total BUCKETED to the next power of two (zero
+    pad rows at the tail). Bucketing keeps the compiled-step signature
+    stable across batches whose token totals differ — without it every
+    batch of a text model recompiles (the shape-key design of SURVEY §7).
+    Pad rows carry segment id N (out of range), which every lengths-aware
+    sequence op drops (jax segment_* ignore out-of-range ids; packers mask
+    by lengths). Programs that apply a raw elementwise reduction straight
+    over flat LoD rows should disable via PADDLE_TPU_LOD_BUCKETING=0.
+    static_info additionally carries ``<name>@MAXLEN`` — the bucketed max
+    per-sequence length that bounds scan depth in the RNN packers.
+    """
+    feed_arrays, feed_lods, static_info = {}, {}, {}
+    bucket_on = _flag_on("PADDLE_TPU_LOD_BUCKETING", default=True)
+    for k, v in feed.items():
+        if isinstance(v, LoDTensor):
+            arr = v.data
+            if v.lod:
+                # sequence ops consume per-sequence LENGTHS (not offsets)
+                lengths = v.recursive_sequence_lengths()[-1]
+                feed_lods[k + "@LOD"] = np.asarray(lengths, np.int32)
+                mx = max(1, int(max(lengths, default=1)))
+                static_info[k + "@MAXLEN"] = 1 << (mx - 1).bit_length()
+                total = int(arr.shape[0])
+                bucket = 1 << max(0, int(total - 1).bit_length())
+                if bucket_on and bucket > total:
+                    pad = np.zeros((bucket - total,) + arr.shape[1:],
+                                   arr.dtype)
+                    arr = np.concatenate([arr, pad], axis=0)
+            feed_arrays[k] = arr
+        else:
+            feed_arrays[k] = np.asarray(v) \
+                if not isinstance(v, jax.Array) else v
+    feed_arrays.update(feed_lods)
+    return feed_arrays, static_info
 
 
 def as_numpy(value):
@@ -97,20 +139,7 @@ class Executor:
         # the feed — the per-feed BUCKETED max sequence length (next power
         # of two), which bounds in-graph padding at ~Tmax instead of the
         # total token count (the shape-key bucketing of SURVEY.md §7).
-        feed_arrays, feed_lods = {}, {}
-        static_info = {}
-        for k, v in feed.items():
-            if isinstance(v, LoDTensor):
-                feed_arrays[k] = v.data
-                if v.lod:
-                    # sequence ops consume per-sequence LENGTHS (not offsets)
-                    lengths = v.recursive_sequence_lengths()[-1]
-                    feed_lods[k + "@LOD"] = np.asarray(lengths, np.int32)
-                    mx = max(1, int(max(lengths, default=1)))
-                    static_info[k + "@MAXLEN"] = 1 << (mx - 1).bit_length()
-            else:
-                feed_arrays[k] = np.asarray(v) if not isinstance(v, jax.Array) else v
-        feed_arrays.update(feed_lods)
+        feed_arrays, static_info = _normalize_feeds(feed)
 
         # State = persistable vars of this program that exist in scope.
         persistable = [v.name for v in program.global_block().vars.values()
@@ -150,7 +179,9 @@ class Executor:
         self._rng_counter += 1
 
         with jax.default_device(self.place.jax_device()):
-            fetches, new_state, guards = entry(state, feed_arrays, rng_key)
+            fetches, new_state, guards, fetch_lods = entry(
+                state, feed_arrays, rng_key)
+        fetches = self._trim_fetches(fetch_names, fetches, fetch_lods)
 
         # Commit updated persistable state back to the scope.
         for n, v in new_state.items():
@@ -208,6 +239,9 @@ class Executor:
             self._check_guards(
                 {k: v for k, v in env.items() if k.startswith(_NANGUARD)})
         fetches = [_fetch_from_env(env, n) for n in fetch_names]
+        fetch_lods = {n: env[n + "@LOD"] for n in fetch_names
+                      if env.get(n + "@LOD") is not None}
+        fetches = self._trim_fetches(fetch_names, fetches, fetch_lods)
         if return_numpy:
             return [as_numpy(v) for v in fetches]
         return fetches
@@ -257,9 +291,30 @@ class Executor:
                         and not n.startswith(_NANGUARD):
                     new_state[n] = env[n]
             guards = {k: v for k, v in env.items() if k.startswith(_NANGUARD)}
-            return fetches, new_state, guards
+            # per-fetch LoD lengths: the caller trims bucket-pad rows off
+            # LoD-carrying fetches host-side (flat totals are bucketed, see
+            # _normalize_feeds)
+            fetch_lods = {n: env[n + "@LOD"] for n in fetch_names
+                          if env.get(n + "@LOD") is not None}
+            return fetches, new_state, guards, fetch_lods
 
         return step
+
+    @staticmethod
+    def _trim_fetches(fetch_names, fetches, fetch_lods):
+        """Slice bucket-pad rows off fetched LoD values (true total =
+        sum of the value's sequence lengths)."""
+        if not fetch_lods:
+            return list(fetches)
+        out = []
+        for n, v in zip(fetch_names, fetches):
+            lod = fetch_lods.get(n)
+            if lod is not None and getattr(v, "ndim", 0) >= 1:
+                total = int(np.sum(np.asarray(lod)))
+                if v.shape[0] > total:
+                    v = v[:total]
+            out.append(v)
+        return out
 
     @staticmethod
     def _lower_with_grad(ctx, ops, bwd_idx, program, block):
@@ -365,10 +420,20 @@ def _record_nan_guards(ctx, op):
         v = ctx.env.get(name)
         dt = getattr(v, "dtype", None)
         if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            fin = jnp.isfinite(v)
+            lod = ctx.env.get(name + "@LOD")
+            if lod is not None and getattr(v, "ndim", 0) >= 1:
+                # bucket-pad rows (past sum(lengths)) are zero filler and
+                # may legitimately be non-finite downstream of log/div —
+                # only the real rows count (executor.cc:27-94 scans real
+                # tensor contents only)
+                valid = jnp.arange(v.shape[0]) < jnp.sum(lod)
+                fin = fin | ~valid.reshape(
+                    (v.shape[0],) + (1,) * (v.ndim - 1))
             idx = getattr(ctx, "_nan_idx", 0)
             ctx._nan_idx = idx + 1
             ctx.env["%s%d|%s|%s" % (_NANGUARD, idx, op.type, name)] = \
-                jnp.isfinite(v).all()
+                fin.all()
 
 
 def _propagate_lod(ctx, op):

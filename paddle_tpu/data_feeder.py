@@ -8,7 +8,7 @@ name. LoD-level>0 vars become padded arrays + `<name>@LOD` length vectors
 
 import numpy as np
 
-from .core.lod import LoDTensor, pack_sequences
+from .core.lod import LoDTensor
 from .core.program import Variable, convert_dtype, default_main_program
 
 
@@ -31,13 +31,19 @@ class DataFeeder:
             dtype = np.dtype(convert_dtype(var.dtype))
             if var.lod_level and var.lod_level > 0:
                 seqs = [np.asarray(s, dtype=dtype) for s in col]
-                # reference shape convention: sequence features often [Ti] ids
-                # or [Ti, D]; pad to [B, Tmax, ...] and attach lengths
                 if seqs and seqs[0].ndim == 0:
                     seqs = [s.reshape(1) for s in seqs]
-                padded, lengths = pack_sequences(seqs, dtype=dtype)
-                t = LoDTensor(padded)
-                t.set_recursive_sequence_lengths([list(map(int, lengths))])
+                # FLAT concatenated rows [sum(Ti), ...] + lengths — the one
+                # LoD representation every sequence op consumes (same as
+                # create_lod_tensor; ops read `<name>@LOD` for boundaries)
+                flat = np.concatenate(seqs, axis=0) if seqs else \
+                    np.zeros((0,), dtype)
+                if flat.ndim == 1 and var.shape and \
+                        len(var.shape) >= 1 and int(var.shape[-1]) == 1:
+                    flat = flat.reshape(-1, 1)   # [T] ids -> [T, 1]
+                t = LoDTensor(flat)
+                t.set_recursive_sequence_lengths(
+                    [[len(s) for s in seqs]])
                 out[var.name] = t
             else:
                 arr = np.asarray(col, dtype=dtype)
@@ -53,12 +59,33 @@ class DataFeeder:
 
     def feed_parallel(self, iterable, num_places):
         """Split one batch into per-device sub-batches (SplitLoDTensor
-        equivalent for the data-parallel executor)."""
+        equivalent, lod_tensor.h:149: WHOLE sequences go to one device).
+
+        Dense feeds split on the batch axis; flat LoD feeds split on the
+        SEQUENCE axis — each device gets its sequences' contiguous rows
+        plus a matching lengths LoDTensor, never a mid-sequence cut."""
         full = self.feed(iterable)
         outs = [dict() for _ in range(num_places)]
         for name, val in full.items():
-            arr = val.data if isinstance(val, LoDTensor) else val
-            chunks = np.array_split(arr, num_places)
-            for i, c in enumerate(chunks):
-                outs[i][name] = c
+            if isinstance(val, LoDTensor) and val.lod:
+                lengths = val.recursive_sequence_lengths()[-1]
+                seq_chunks = np.array_split(np.arange(len(lengths)),
+                                            num_places)
+                starts = np.cumsum([0] + list(lengths))
+                for i, seqs in enumerate(seq_chunks):
+                    if len(seqs):
+                        lo = starts[seqs[0]]
+                        hi = starts[seqs[-1] + 1]
+                        part = val.data[lo:hi]
+                        part_lens = [lengths[s] for s in seqs]
+                    else:
+                        part = val.data[:0]
+                        part_lens = []
+                    t = LoDTensor(part)
+                    t.set_recursive_sequence_lengths([part_lens])
+                    outs[i][name] = t
+            else:
+                arr = val.data if isinstance(val, LoDTensor) else val
+                for i, c in enumerate(np.array_split(arr, num_places)):
+                    outs[i][name] = c
         return outs

@@ -397,3 +397,59 @@ reduce_mean = reduce_op_layer("reduce_mean")
 reduce_max = reduce_op_layer("reduce_max")
 reduce_min = reduce_op_layer("reduce_min")
 reduce_prod = reduce_op_layer("reduce_prod")
+
+
+# ---------------------------------------------------------------------------
+# CRF layers (python/paddle/fluid/layers/nn.py linear_chain_crf/crf_decoding)
+# ---------------------------------------------------------------------------
+
+def linear_chain_crf(input, label, param_attr=None, name=None):
+    """CRF negative log-likelihood over emission `input` [T, D] (LoD).
+
+    Creates the Transition parameter [D+2, D] (row 0 start, row 1 end,
+    rows 2.. transitions — linear_chain_crf_op.cc layout) and returns the
+    per-sequence NLL [N, 1]; train with mean(nll)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr,
+                         name=name)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    eexp = helper.create_variable_for_type_inference(input.dtype)
+    texp = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Label": [label],
+                "Transition": [transition]},
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [eexp], "TransitionExps": [texp]})
+    return ll
+
+
+def crf_decoding(input, param_attr=None, name=None, label=None):
+    """Viterbi decode against the transition parameter created by
+    linear_chain_crf (share via param_attr name)."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr, name=name)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    path = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]})
+    return path
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity (operators/cos_sim_op.cc)."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(
+        X.dtype, shape=(X.shape[0] if X.shape else -1, 1))
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out

@@ -17,5 +17,21 @@ def I64():  # noqa: N802 — reads as the dtype constant it stands for
     return jnp.dtype(runtime_dtype("int64"))
 
 
-def F64():  # noqa: N802
-    return jnp.dtype(runtime_dtype("float64"))
+def lod_valid_mask(ctx, op, slot="X"):
+    """Row-validity mask for a LoD-carrying input under flat-total
+    bucketing (core/executor._normalize_feeds): rows past sum(lengths) are
+    zero padding and must not contribute to reductions. Returns
+    (valid_bool[t], n_valid) or (None, None) when the input carries no LoD
+    or is scalar."""
+    names = op.input(slot)
+    if not names:
+        return None, None
+    lens = ctx.maybe_get(names[0] + "@LOD")
+    if lens is None:
+        return None, None
+    x = ctx.env.get(names[0])
+    if x is None or getattr(x, "ndim", 0) < 1:
+        return None, None
+    n_valid = jnp.sum(lens)
+    valid = jnp.arange(x.shape[0]) < n_valid
+    return valid, n_valid

@@ -244,6 +244,12 @@ def _chunk_eval(ctx, op):
     seg = jnp.searchsorted(ends, jnp.arange(t), side="right")
     starts_ = ends - lens
     pos = jnp.arange(t) - starts_[seg]
+    # bucket-pad rows past the true total carry tag 0 (= B of type 0);
+    # force them to an out-of-range tag so no scheme counts them as chunks
+    valid = jnp.arange(t) < ends[-1]
+    sentinel = 2 * num_types + 7
+    inf = jnp.where(valid, inf, sentinel)
+    lab = jnp.where(valid, lab, sentinel)
 
     def chunk_starts(tags):
         if scheme == "plain":

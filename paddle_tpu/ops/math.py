@@ -85,7 +85,19 @@ def _sum(ctx, op):
 
 @register("mean")
 def _mean(ctx, op):
-    ctx.set_out(op, "Out", jnp.mean(ctx.in1(op, "X")))
+    from .common import lod_valid_mask
+    x = ctx.in1(op, "X")
+    valid, n_valid = lod_valid_mask(ctx, op)
+    if valid is None:
+        ctx.set_out(op, "Out", jnp.mean(x))
+        return
+    # LoD input under flat-total bucketing: average the REAL rows only
+    vm = valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+    per_row = 1
+    for s in x.shape[1:]:
+        per_row *= s
+    total = jnp.sum(jnp.where(vm, x, 0))
+    ctx.set_out(op, "Out", total / (n_valid.astype(x.dtype) * per_row))
 
 
 @register("scale")
@@ -116,7 +128,7 @@ def _clip_by_norm(ctx, op):
                 jnp.where(norm > max_norm, x * (max_norm / norm), x))
 
 
-def _reduce(fn):
+def _reduce(fn, fill=None):
     def lower(ctx, op):
         x = ctx.in1(op, "X")
         dim = op.attr("dim", [0])
@@ -126,16 +138,35 @@ def _reduce(fn):
             if isinstance(dim, int):
                 dim = [dim]
             axes = tuple(d % x.ndim for d in dim)
-        out = fn(x, axis=axes, keepdims=op.attr("keep_dim", False))
-        ctx.set_out(op, "Out", out)
+        keep = op.attr("keep_dim", False)
+        if axes is None or 0 in axes:
+            # bucketed LoD input: neutralize pad rows before reducing the
+            # row axis (sum/mean: 0; max: -inf; min: +inf; prod: 1)
+            from .common import lod_valid_mask
+            valid, n_valid = lod_valid_mask(ctx, op)
+            if valid is not None:
+                vm = valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+                if fn is jnp.mean:
+                    red = tuple(range(x.ndim)) if axes is None else axes
+                    other = 1
+                    for a in red:
+                        if a != 0:
+                            other *= x.shape[a]
+                    s = jnp.sum(jnp.where(vm, x, 0), axis=axes,
+                                keepdims=keep)
+                    ctx.set_out(op, "Out",
+                                s / (n_valid.astype(x.dtype) * other))
+                    return
+                x = jnp.where(vm, x, fill)
+        ctx.set_out(op, "Out", fn(x, axis=axes, keepdims=keep))
     return lower
 
 
-register("reduce_sum", _reduce(jnp.sum))
+register("reduce_sum", _reduce(jnp.sum, fill=0))
 register("reduce_mean", _reduce(jnp.mean))
-register("reduce_max", _reduce(jnp.max))
-register("reduce_min", _reduce(jnp.min))
-register("reduce_prod", _reduce(jnp.prod))
+register("reduce_max", _reduce(jnp.max, fill=-jnp.inf))
+register("reduce_min", _reduce(jnp.min, fill=jnp.inf))
+register("reduce_prod", _reduce(jnp.prod, fill=1))
 
 
 @register("cumsum")
@@ -150,14 +181,25 @@ def _cumsum(ctx, op):
     ctx.set_out(op, "Out", out)
 
 
+def _masked_rows(ctx, op, x, fill=0):
+    from .common import lod_valid_mask
+    valid, _ = lod_valid_mask(ctx, op)
+    if valid is None:
+        return x
+    vm = valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+    return jnp.where(vm, x, fill)
+
+
 @register("l1_norm")
 def _l1_norm(ctx, op):
-    ctx.set_out(op, "Out", jnp.sum(jnp.abs(ctx.in1(op, "X"))))
+    x = _masked_rows(ctx, op, ctx.in1(op, "X"))
+    ctx.set_out(op, "Out", jnp.sum(jnp.abs(x)))
 
 
 @register("squared_l2_norm")
 def _squared_l2_norm(ctx, op):
-    ctx.set_out(op, "Out", jnp.sum(jnp.square(ctx.in1(op, "X"))))
+    x = _masked_rows(ctx, op, ctx.in1(op, "X"))
+    ctx.set_out(op, "Out", jnp.sum(jnp.square(x)))
 
 
 @register("squared_l2_distance")

@@ -86,21 +86,13 @@ class ParallelExecutor:
         dp = 1
         if "dp" in self.mesh.axis_names:
             dp = self.mesh.shape["dp"]
-        feed_arrays = {}
-        lod_keys = set()
-        for k, v in feed.items():
-            if isinstance(v, LoDTensor):
-                # ragged token buffers keep a replicated layout (their row
-                # count is data-dependent); GSPMD re-shards downstream
-                lengths = v.recursive_sequence_lengths()[-1] if v.lod else []
-                feed_arrays[k] = v.data
-                lod_keys.add(k)
-                if lengths:
-                    feed_arrays[k + "@LOD"] = np.asarray(lengths, np.int32)
-                    lod_keys.add(k + "@LOD")
-            else:
-                feed_arrays[k] = np.asarray(v) \
-                    if not isinstance(v, jax.Array) else v
+        # ragged token buffers keep a replicated layout (their row count is
+        # data-dependent); GSPMD re-shards downstream. _normalize_feeds also
+        # buckets the flat LoD totals so signatures stay cache-stable.
+        from ..core.executor import _normalize_feeds
+        feed_arrays, static_info = _normalize_feeds(feed)
+        lod_keys = {k for k in feed_arrays if k.endswith("@LOD")}
+        lod_keys |= {k for k, v in feed.items() if isinstance(v, LoDTensor)}
         for k, v in feed_arrays.items():
             if k in lod_keys:
                 continue
@@ -121,12 +113,14 @@ class ParallelExecutor:
         from ..core.executor import _flag_on
         check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
         key = (program, program._version, _feed_signature(feed_arrays),
-               fetch_names, state_keys, hints, check_nan)
+               fetch_names, state_keys, hints, check_nan,
+               tuple(sorted(static_info.items())))
         entry = self._cache.get(key)
         repl = NamedSharding(self.mesh, PartitionSpec())
         if entry is None:
             fn = self._exe._build(program, tuple(sorted(feed_arrays)),
                                   fetch_names, state_keys,
+                                  static_info=static_info,
                                   check_nan=check_nan)
             data_sh = self._data_sharding()
             state_sh = {n: self._state_sharding(n) for n in state_keys}
@@ -153,7 +147,9 @@ class ParallelExecutor:
         feeds_dev = {k: jax.device_put(v, repl if k in lod_keys else data_sh)
                      for k, v in feed_arrays.items()}
 
-        fetches, new_state, guards = entry(state_dev, feeds_dev, rng_key)
+        fetches, new_state, guards, fetch_lods = entry(
+            state_dev, feeds_dev, rng_key)
+        fetches = Executor._trim_fetches(fetch_names, fetches, fetch_lods)
         for n, v in new_state.items():
             scope.set(n, v)
         if check_nan:

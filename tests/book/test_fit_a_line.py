@@ -1,0 +1,59 @@
+"""Book test: fit_a_line (reference
+python/paddle/fluid/tests/book/test_fit_a_line.py) — linear regression on
+uci_housing trained to a loss threshold, plus the save/load_inference_model
+round-trip the reference does after training."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu as fluid
+
+
+def test_fit_a_line_trains_and_roundtrips():
+    x = fluid.layers.data("x", [13])
+    y = fluid.layers.data("y", [1])
+    y_predict = fluid.layers.fc(x, 1)
+    cost = fluid.layers.square_error_cost(y_predict, y)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(), 500),
+        batch_size=20)
+    feeder = fluid.DataFeeder([x, y], fluid.CPUPlace())
+
+    first = last = None
+    for epoch in range(15):
+        for batch in train_reader():
+            feed = feeder.feed(batch)
+            lv, = exe.run(feed=feed, fetch_list=[avg_cost])
+            if first is None:
+                first = float(lv)
+            last = float(lv)
+    assert last < 1.0, (first, last)   # reference threshold: avg loss < 10
+
+    # save/load_inference_model round-trip (test_fit_a_line.py infer())
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "fit_a_line.model")
+        fluid.io.save_inference_model(path, ["x"], [y_predict], exe)
+        probe = np.random.RandomState(0).rand(4, 13).astype(np.float32)
+        # the un-pruned program computes the loss too, so feed a dummy label
+        want, = exe.run(feed={"x": probe,
+                              "y": np.zeros((4, 1), np.float32)},
+                        fetch_list=[y_predict])
+
+        scope = fluid.Scope()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            prog, feed_names, fetch_vars = \
+                fluid.io.load_inference_model(path, exe2)
+            got, = exe2.run(prog, feed={feed_names[0]: probe},
+                            fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

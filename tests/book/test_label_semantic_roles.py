@@ -1,0 +1,87 @@
+"""Book test: label_semantic_roles (reference
+python/paddle/fluid/tests/book/test_label_semantic_roles.py) — SRL tagger
+over conll05: word/context/predicate/mark embeddings -> fc -> bi-directional
+dynamic LSTM -> CRF loss, with Viterbi decoding sharing the transition
+parameter."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu as fluid
+
+
+WORD_DIM = 16
+HIDDEN = 64   # dynamic_lstm size (= 4*hidden): hidden 16
+DEPTH = 2
+
+
+def db_lstm(word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark):
+    word_vocab = paddle.dataset.conll05.WORD_VOCAB
+    verb_vocab = paddle.dataset.conll05.VERB_VOCAB
+    label_count = paddle.dataset.conll05.LABEL_COUNT
+
+    shared = fluid.ParamAttr(name="word_emb")
+    embs = [fluid.layers.embedding(w, size=[word_vocab, WORD_DIM],
+                                   param_attr=shared)
+            for w in (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2)]
+    embs.append(fluid.layers.embedding(predicate,
+                                       size=[verb_vocab, WORD_DIM]))
+    embs.append(fluid.layers.embedding(mark, size=[2, WORD_DIM]))
+
+    hidden0 = fluid.layers.fc(fluid.layers.concat(embs, axis=1), HIDDEN,
+                              act="tanh")
+    lstm0, _ = fluid.layers.dynamic_lstm(hidden0, size=HIDDEN)
+    inp = [hidden0, lstm0]
+    for i in range(1, DEPTH):
+        mix = fluid.layers.fc(fluid.layers.concat(inp, axis=1), HIDDEN,
+                              act="tanh")
+        lstm, _ = fluid.layers.dynamic_lstm(mix, size=HIDDEN,
+                                            is_reverse=(i % 2 == 1))
+        inp = [mix, lstm]
+    feature_out = fluid.layers.fc(fluid.layers.concat(inp, axis=1),
+                                  label_count)
+    return feature_out
+
+
+def test_label_semantic_roles_crf_trains():
+    names = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+             "verb", "mark"]
+    feats = [fluid.layers.data(n, [1], dtype="int64", lod_level=1)
+             for n in names]
+    target = fluid.layers.data("target", [1], dtype="int64", lod_level=1)
+    feature_out = db_lstm(*feats)
+    crf_cost = fluid.layers.linear_chain_crf(
+        feature_out, target,
+        param_attr=fluid.ParamAttr(name="crfw"))
+    avg_cost = fluid.layers.mean(crf_cost)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    # decoding shares the learned transition parameter by name
+    path = fluid.layers.crf_decoding(
+        feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feeder = fluid.DataFeeder(feats + [target], fluid.CPUPlace())
+    batches = list(paddle.batch(paddle.dataset.conll05.train(),
+                                batch_size=8)())[:12]
+
+    epoch_means = []
+    for epoch in range(6):
+        losses = []
+        for batch in batches:
+            feed = feeder.feed(batch)
+            lv, = exe.run(feed=feed, fetch_list=[avg_cost])
+            losses.append(float(lv))
+        epoch_means.append(float(np.mean(losses)))
+    assert np.isfinite(epoch_means[-1])
+    assert epoch_means[-1] < epoch_means[0] * 0.6, epoch_means
+
+    # Viterbi path: valid label ids, one per token of the first sequence
+    feed = feeder.feed(batches[0])
+    pv, = exe.run(feed=feed, fetch_list=[path])
+    pv = np.asarray(pv)
+    assert pv.dtype in (np.int32, np.int64)
+    assert (pv >= 0).all() and \
+        (pv < paddle.dataset.conll05.LABEL_COUNT).all()

@@ -1,0 +1,82 @@
+"""Book test: recommender_system (reference
+python/paddle/fluid/tests/book/test_recommender_system.py) — two-tower
+user/movie model over movielens: id/categorical embeddings + pooled
+sequence features -> cos_sim -> scaled score regression."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu as fluid
+import paddle_tpu.dataset.movielens as movielens
+
+
+def get_usr_combined_features():
+    usr_id = fluid.layers.data("user_id", [1], dtype="int64")
+    gender = fluid.layers.data("gender_id", [1], dtype="int64")
+    age = fluid.layers.data("age_id", [1], dtype="int64")
+    job = fluid.layers.data("job_id", [1], dtype="int64")
+    emb = lambda x, n: fluid.layers.fc(
+        fluid.layers.embedding(x, size=[n, 16]), 16)
+    feats = [emb(usr_id, movielens.max_user_id() + 1),
+             emb(gender, 2),
+             emb(age, len(movielens.age_table()) + 1),
+             emb(job, movielens.max_job_id() + 1)]
+    concat = fluid.layers.concat(feats, axis=1)
+    return fluid.layers.fc(concat, 32, act="tanh"), \
+        [usr_id, gender, age, job]
+
+
+def get_mov_combined_features():
+    mov_id = fluid.layers.data("movie_id", [1], dtype="int64")
+    category = fluid.layers.data("category_id", [1], dtype="int64",
+                                 lod_level=1)
+    title = fluid.layers.data("movie_title", [1], dtype="int64",
+                              lod_level=1)
+    mov_emb = fluid.layers.fc(
+        fluid.layers.embedding(mov_id, size=[movielens.max_movie_id() + 1,
+                                             16]), 16)
+    cat_pool = fluid.layers.sequence_pool(
+        fluid.layers.embedding(category,
+                               size=[movielens.CATEGORIES, 16]), "sum")
+    title_pool = fluid.layers.sequence_pool(
+        fluid.layers.embedding(title, size=[movielens.TITLE_VOCAB + 1, 16]),
+        "sum")
+    concat = fluid.layers.concat([mov_emb, cat_pool, title_pool], axis=1)
+    return fluid.layers.fc(concat, 32, act="tanh"), \
+        [mov_id, category, title]
+
+
+def test_recommender_system_trains():
+    usr, usr_vars = get_usr_combined_features()
+    mov, mov_vars = get_mov_combined_features()
+    inference = fluid.layers.cos_sim(usr, mov)
+    scale_infer = fluid.layers.scale(inference, scale=5.0)
+    label = fluid.layers.data("score", [1])
+    cost = fluid.layers.square_error_cost(scale_infer, label)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    reader = paddle.batch(paddle.reader.shuffle(movielens.train(), 256),
+                          batch_size=32)
+    feed_vars = usr_vars + mov_vars + [label]
+    feeder = fluid.DataFeeder(feed_vars, fluid.CPUPlace())
+
+    first = last = None
+    for epoch in range(8):
+        for batch in reader():
+            feed = feeder.feed(batch)
+            for k in ("user_id", "gender_id", "age_id", "job_id",
+                      "movie_id"):
+                feed[k] = np.asarray(feed[k]).reshape(-1, 1)
+            feed["score"] = np.asarray(feed["score"]).reshape(-1, 1)
+            lv, = exe.run(feed=feed, fetch_list=[avg_cost])
+            if first is None:
+                first = float(lv)
+            last = float(lv)
+    # reference threshold: test cost < 6 (score scale 1-5); require a real
+    # fit well under the variance of the score distribution
+    assert last < first * 0.7, (first, last)
+    assert last < 2.0, last

@@ -1,0 +1,64 @@
+"""Book test: rnn_encoder_decoder (reference
+python/paddle/fluid/tests/book/notest_rnn_encoder_decoer.py) — GRU encoder
+whose final state initializes a GRU decoder; teacher-forced training on
+wmt14-style (src, trg, trg_next) triples to a loss threshold.
+
+The per-token cross-entropy rows are pooled per sequence (sequence_pool sum
+-> mean over sequences) so the loss is exact under the executor's
+flat-total bucketing (pad rows are dropped by the segment pooling)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu as fluid
+
+
+EMB = 16
+GRU = 32
+
+
+def seq_to_seq_net(src, trg, label, dict_size):
+    src_emb = fluid.layers.embedding(src, size=[dict_size, EMB])
+    enc_in = fluid.layers.fc(src_emb, GRU * 3)
+    enc = fluid.layers.dynamic_gru(enc_in, size=GRU)
+    enc_last = fluid.layers.sequence_last_step(enc)
+
+    trg_emb = fluid.layers.embedding(trg, size=[dict_size, EMB])
+    dec_in = fluid.layers.fc(trg_emb, GRU * 3)
+    dec = fluid.layers.dynamic_gru(dec_in, size=GRU, h_0=enc_last)
+    prediction = fluid.layers.fc(dec, dict_size, act="softmax")
+
+    cost = fluid.layers.cross_entropy(prediction, label)   # [T, 1] rows
+    seq_cost = fluid.layers.sequence_pool(cost, "sum")     # [N, 1] exact
+    return fluid.layers.mean(seq_cost), prediction
+
+
+def test_rnn_encoder_decoder_trains():
+    dict_size = paddle.dataset.wmt14.DICT_SIZE
+    src = fluid.layers.data("src_word_id", [1], dtype="int64", lod_level=1)
+    trg = fluid.layers.data("target_language_word", [1], dtype="int64",
+                            lod_level=1)
+    label = fluid.layers.data("target_language_next_word", [1],
+                              dtype="int64", lod_level=1)
+    avg_cost, prediction = seq_to_seq_net(src, trg, label, dict_size)
+    fluid.optimizer.Adam(learning_rate=0.005).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feeder = fluid.DataFeeder([src, trg, label], fluid.CPUPlace())
+    batches = list(paddle.batch(paddle.dataset.wmt14.train(dict_size),
+                                batch_size=8)())[:10]
+
+    first = last = None
+    for epoch in range(8):
+        for batch in batches:
+            feed = feeder.feed(batch)
+            lv, = exe.run(feed=feed, fetch_list=[avg_cost])
+            if first is None:
+                first = float(lv)
+            last = float(lv)
+    assert np.isfinite(last)
+    # reference stops at avg_cost < 2 (per-token); ours is per-sequence
+    # summed cost — require a real drop from the initial uniform entropy
+    assert last < first * 0.6, (first, last)

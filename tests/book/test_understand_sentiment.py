@@ -1,0 +1,62 @@
+"""Book test: understand_sentiment (reference
+python/paddle/fluid/tests/book/test_understand_sentiment.py, stacked-LSTM
+variant) — embedding -> fc -> dynamic LSTM -> sequence pools -> softmax
+classifier on imdb, trained to an accuracy threshold."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu as fluid
+
+
+def stacked_lstm_net(data, label, input_dim, class_dim=2, emb_dim=32,
+                     hid_dim=64, stacked_num=2):
+    # reference stacked_lstm_net shape: fc+lstm pairs (dynamic_lstm's `size`
+    # equals the input width = 4*hidden), pool the last pair
+    emb = fluid.layers.embedding(data, size=[input_dim, emb_dim])
+    fc1 = fluid.layers.fc(emb, hid_dim)
+    lstm1, cell1 = fluid.layers.dynamic_lstm(fc1, size=hid_dim)
+    inputs = [fc1, lstm1]
+    for _ in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(fluid.layers.concat(inputs, axis=1), hid_dim)
+        lstm, cell = fluid.layers.dynamic_lstm(
+            fc, size=hid_dim, is_reverse=True)
+        inputs = [fc, lstm]
+    fc_last = fluid.layers.sequence_pool(inputs[0], "max")
+    lstm_last = fluid.layers.sequence_pool(inputs[1], "max")
+    prediction = fluid.layers.fc(
+        fluid.layers.concat([fc_last, lstm_last], axis=1),
+        class_dim, act="softmax")
+    cost = fluid.layers.cross_entropy(prediction, label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(prediction, label)
+    return avg_cost, acc, prediction
+
+
+def test_understand_sentiment_stacked_lstm():
+    data = fluid.layers.data("words", [1], dtype="int64", lod_level=1)
+    label = fluid.layers.data("label", [1], dtype="int64")
+    avg_cost, acc, _ = stacked_lstm_net(
+        data, label, input_dim=paddle.dataset.imdb.VOCAB_SIZE)
+    fluid.optimizer.Adam(learning_rate=0.002).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    reader = paddle.batch(paddle.dataset.imdb.train(None), batch_size=16)
+    feeder = fluid.DataFeeder([data, label], fluid.CPUPlace())
+
+    first = last = last_acc = None
+    for epoch in range(6):
+        accs = []
+        for batch in reader():
+            feed = feeder.feed(batch)
+            feed["label"] = np.asarray(feed["label"]).reshape(-1, 1)
+            lv, av = exe.run(feed=feed, fetch_list=[avg_cost, acc])
+            if first is None:
+                first = float(lv)
+            last = float(lv)
+            accs.append(float(np.asarray(av).ravel()[0]))
+        last_acc = float(np.mean(accs))
+    assert last < first, (first, last)
+    assert last_acc > 0.7, last_acc   # reference threshold: acc converges

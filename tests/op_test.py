@@ -190,6 +190,10 @@ def check_grad(op_type, inputs, attrs, wrt, out="Out", out_slots=None,
             orig_feed = feed[key]
             is_lod = isinstance(orig_feed, fluid.LoDTensor)
             base_arr = np.asarray(orig_feed.data if is_lod else orig_feed)
+            if is_lod and got.shape[0] > base_arr.shape[0]:
+                # executor bucket-pads flat LoD feeds; grads of the pad
+                # rows are zero by construction — compare the real rows
+                got = got[:base_arr.shape[0]]
             base = base_arr.astype(np.float64)
 
             def refeed(arr):

@@ -1,0 +1,112 @@
+"""Flat-total LoD bucketing (core/executor._normalize_feeds): compile
+signatures stay stable across batches with different token totals, while
+reductions, NaN guards, and fetches see only the REAL rows."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _lod(arr, lengths):
+    t = fluid.LoDTensor(np.asarray(arr))
+    t.set_recursive_sequence_lengths([list(lengths)])
+    return t
+
+
+def test_bucketing_keeps_compile_signature_stable():
+    x = fluid.layers.data("x", [2], lod_level=1)
+    y = fluid.layers.fc(x, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # totals 5, 6, 7 all bucket to 8 -> ONE compiled entry for all three
+    n0 = len(exe._cache)
+    for total, lens in ((5, [2, 3]), (6, [3, 3]), (7, [3, 4])):
+        arr = np.random.rand(total, 2).astype(np.float32)
+        out, = exe.run(feed={"x": _lod(arr, lens)}, fetch_list=[y])
+        assert np.asarray(out).shape == (total, 3)   # trimmed to real rows
+    assert len(exe._cache) == n0 + 1
+
+
+def test_mean_over_bucketed_rows_is_exact():
+    x = fluid.layers.data("x", [1], lod_level=1)
+    m = fluid.layers.mean(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.arange(1, 7, dtype=np.float32).reshape(6, 1)  # bucket pads to 8
+    got, = exe.run(feed={"x": _lod(arr, [2, 4])}, fetch_list=[m])
+    np.testing.assert_allclose(float(np.asarray(got)), arr.mean(),
+                               rtol=1e-6)
+
+
+def test_reduce_ops_mask_bucket_pad_rows():
+    x = fluid.layers.data("x", [1], lod_level=1)
+    s = fluid.layers.reduce_sum(x, dim=None, keep_dim=False) \
+        if hasattr(fluid.layers, "reduce_sum") else None
+    mx = fluid.layers.reduce_max(x)
+    mn = fluid.layers.reduce_min(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = -np.arange(1, 7, dtype=np.float32).reshape(6, 1)  # all negative
+    feed = {"x": _lod(arr, [3, 3])}
+    got_max, got_min = exe.run(feed=feed, fetch_list=[mx, mn])
+    # zero pad rows must not win the max (all real values are negative)
+    np.testing.assert_allclose(float(np.asarray(got_max).ravel()[0]), -1.0)
+    np.testing.assert_allclose(float(np.asarray(got_min).ravel()[0]), -6.0)
+
+
+def test_token_loss_pipeline_exact_under_bucketing():
+    # the review scenario: mean(cross_entropy(...)) straight over flat rows
+    x = fluid.layers.data("emb", [4], lod_level=1)
+    label = fluid.layers.data("lbl", [1], dtype="int64", lod_level=1)
+    pred = fluid.layers.fc(x, 5, act="softmax",
+                           param_attr=fluid.ParamAttr(
+                               initializer=fluid.initializer.Constant(0.1)))
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    arr = rng.rand(6, 4).astype(np.float32)        # pads to 8
+    lbl = rng.randint(0, 5, (6, 1)).astype(np.int64)
+    got, = exe.run(feed={"emb": _lod(arr, [2, 4]),
+                         "lbl": _lod(lbl, [2, 4])}, fetch_list=[loss])
+    # numpy reference over the REAL 6 rows only
+    z = arr @ (np.full((4, 5), 0.1, np.float32))
+    e = np.exp(z - z.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    want = float(np.mean(-np.log(p[np.arange(6), lbl.ravel()])))
+    np.testing.assert_allclose(float(np.asarray(got)), want, rtol=1e-5)
+
+
+def test_nan_guard_ignores_pad_rows(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "1")
+    x = fluid.layers.data("x", [1], lod_level=1)
+    # log of bucket-pad zeros is -inf but those rows are filler; real rows
+    # are strictly positive -> must NOT raise
+    out = fluid.layers.mean(
+        fluid.default_main_program().current_block().var(x.name))
+    prog = fluid.default_main_program()
+    blk = prog.current_block()
+    logv = blk.create_var(name="logx")
+    blk.append_op("log", {"X": [x]}, {"Out": ["logx"]}, {})
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.ones((6, 1), np.float32)              # pads to 8 with zeros
+    got = exe.run(feed={"x": _lod(arr, [3, 3])}, fetch_list=["logx", out])
+    assert np.isfinite(np.asarray(got[0])).all()
+
+
+def test_feed_parallel_splits_whole_sequences():
+    x = fluid.layers.data("x", [1], dtype="int64", lod_level=1)
+    d = fluid.layers.data("d", [2])
+    feeder = fluid.DataFeeder([x, d], fluid.CPUPlace())
+    batch = [([1, 2, 3], [0.0, 0.0]), ([4], [1.0, 1.0]),
+             ([5, 6], [2.0, 2.0]), ([7, 8, 9], [3.0, 3.0])]
+    outs = feeder.feed_parallel(batch, 2)
+    assert len(outs) == 2
+    p0, p1 = outs[0]["x"], outs[1]["x"]
+    assert isinstance(p0, fluid.LoDTensor)
+    assert p0.recursive_sequence_lengths() == [[3, 1]]
+    assert p1.recursive_sequence_lengths() == [[2, 3]]
+    np.testing.assert_array_equal(np.asarray(p0.data).ravel(),
+                                  [1, 2, 3, 4])
+    np.testing.assert_array_equal(np.asarray(p1.data).ravel(),
+                                  [5, 6, 7, 8, 9])
+    assert outs[0]["d"].shape == (2, 2) and outs[1]["d"].shape == (2, 2)
