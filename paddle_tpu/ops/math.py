@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .common import I64
+from .common import I64, lod_valid_mask
 from ..core.registry import register
 
 
@@ -85,19 +85,9 @@ def _sum(ctx, op):
 
 @register("mean")
 def _mean(ctx, op):
-    from .common import lod_valid_mask
     x = ctx.in1(op, "X")
-    valid, n_valid = lod_valid_mask(ctx, op)
-    if valid is None:
-        ctx.set_out(op, "Out", jnp.mean(x))
-        return
-    # LoD input under flat-total bucketing: average the REAL rows only
-    vm = valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
-    per_row = 1
-    for s in x.shape[1:]:
-        per_row *= s
-    total = jnp.sum(jnp.where(vm, x, 0))
-    ctx.set_out(op, "Out", total / (n_valid.astype(x.dtype) * per_row))
+    out = _masked_mean(ctx, op, x, axes=None, keep=False)
+    ctx.set_out(op, "Out", jnp.mean(x) if out is None else out)
 
 
 @register("scale")
@@ -128,6 +118,48 @@ def _clip_by_norm(ctx, op):
                 jnp.where(norm > max_norm, x * (max_norm / norm), x))
 
 
+def _row_mask(valid, x):
+    return valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+
+
+def _fill_value(fill, dtype):
+    """dtype-preserving neutral element ('min'/'max' map to the dtype's
+    extremes so integer reductions stay integer)."""
+    if fill == "min":
+        return jnp.iinfo(dtype).min if jnp.issubdtype(dtype, jnp.integer) \
+            else -jnp.inf
+    if fill == "max":
+        return jnp.iinfo(dtype).max if jnp.issubdtype(dtype, jnp.integer) \
+            else jnp.inf
+    return jnp.asarray(fill, dtype)
+
+
+def _masked_rows(ctx, op, x, fill=0):
+    """x with bucket-pad rows replaced by the neutral `fill` (no-op when
+    the input carries no LoD)."""
+    valid, _ = lod_valid_mask(ctx, op)
+    if valid is None:
+        return x
+    return jnp.where(_row_mask(valid, x), x, _fill_value(fill, x.dtype))
+
+
+def _masked_mean(ctx, op, x, axes, keep):
+    """Mean over the REAL rows of a bucketed LoD input (None when the
+    input carries no LoD and the plain mean applies)."""
+    valid, n_valid = lod_valid_mask(ctx, op)
+    if valid is None:
+        return None
+    red = tuple(range(x.ndim)) if axes is None else axes
+    other = 1
+    for a in red:
+        if a != 0:
+            other *= x.shape[a]
+    s = jnp.sum(jnp.where(_row_mask(valid, x), x, 0), axis=axes,
+                keepdims=keep)
+    acc = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return s / (n_valid.astype(acc) * other)
+
+
 def _reduce(fn, fill=None):
     def lower(ctx, op):
         x = ctx.in1(op, "X")
@@ -141,31 +173,22 @@ def _reduce(fn, fill=None):
         keep = op.attr("keep_dim", False)
         if axes is None or 0 in axes:
             # bucketed LoD input: neutralize pad rows before reducing the
-            # row axis (sum/mean: 0; max: -inf; min: +inf; prod: 1)
-            from .common import lod_valid_mask
-            valid, n_valid = lod_valid_mask(ctx, op)
-            if valid is not None:
-                vm = valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
-                if fn is jnp.mean:
-                    red = tuple(range(x.ndim)) if axes is None else axes
-                    other = 1
-                    for a in red:
-                        if a != 0:
-                            other *= x.shape[a]
-                    s = jnp.sum(jnp.where(vm, x, 0), axis=axes,
-                                keepdims=keep)
-                    ctx.set_out(op, "Out",
-                                s / (n_valid.astype(x.dtype) * other))
+            # row axis (sum: 0; max/min: dtype extremes; prod: 1)
+            if fn is jnp.mean:
+                out = _masked_mean(ctx, op, x, axes, keep)
+                if out is not None:
+                    ctx.set_out(op, "Out", out)
                     return
-                x = jnp.where(vm, x, fill)
+            else:
+                x = _masked_rows(ctx, op, x, fill)
         ctx.set_out(op, "Out", fn(x, axis=axes, keepdims=keep))
     return lower
 
 
 register("reduce_sum", _reduce(jnp.sum, fill=0))
 register("reduce_mean", _reduce(jnp.mean))
-register("reduce_max", _reduce(jnp.max, fill=-jnp.inf))
-register("reduce_min", _reduce(jnp.min, fill=jnp.inf))
+register("reduce_max", _reduce(jnp.max, fill="min"))
+register("reduce_min", _reduce(jnp.min, fill="max"))
 register("reduce_prod", _reduce(jnp.prod, fill=1))
 
 
@@ -179,15 +202,6 @@ def _cumsum(ctx, op):
     if op.attr("exclusive", False):
         out = out - x
     ctx.set_out(op, "Out", out)
-
-
-def _masked_rows(ctx, op, x, fill=0):
-    from .common import lod_valid_mask
-    valid, _ = lod_valid_mask(ctx, op)
-    if valid is None:
-        return x
-    vm = valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
-    return jnp.where(vm, x, fill)
 
 
 @register("l1_norm")

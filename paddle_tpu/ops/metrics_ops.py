@@ -12,13 +12,23 @@ from ..core.registry import register
 
 @register("accuracy")
 def _accuracy(ctx, op):
+    from .common import lod_valid_mask
     indices = ctx.in1(op, "Indices")      # [N, k]
     label = ctx.in1(op, "Label")          # [N, 1] or [N]
     if label.ndim == 2 and label.shape[-1] == 1:
         label = label.reshape(-1)
     hit = jnp.any(indices == label[:, None].astype(indices.dtype), axis=1)
+    # per-token accuracy over a bucketed LoD label: pad rows are neither
+    # hits nor part of the total
+    valid, n_valid = lod_valid_mask(ctx, op, slot="Label")
+    if valid is None:
+        valid, n_valid = lod_valid_mask(ctx, op, slot="Indices")
+    if valid is not None:
+        hit = hit & valid
+        total = n_valid.astype(I64())
+    else:
+        total = jnp.asarray(label.shape[0], I64())
     correct = jnp.sum(hit.astype(I64()))
-    total = jnp.asarray(label.shape[0], I64())
     ctx.set_out(op, "Accuracy",
                 (correct.astype(jnp.float32) / total.astype(jnp.float32)
                  ).reshape(1))

@@ -1,0 +1,41 @@
+"""paddle.v2-style high-level API over the fluid core (SURVEY.md M7).
+
+Reference parity: python/paddle/v2/ — the legacy event-loop training API
+(`SGD.train(reader, event_handler)`, v2/trainer.py:37,137), Parameters
+tar save/load, layer aliases, data types, and `paddle.v2.infer`. The v2
+stack in the reference wraps the same engine the fluid API drives; here
+both front-ends share the Program/Executor core, so v2 and fluid layers
+compose in one model.
+
+Usage (reference book v2 shape):
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(use_gpu=False)
+    images = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    pred = paddle.layer.fc(images, 10, act="softmax")
+    cost = paddle.layer.classification_cost(pred, label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, parameters,
+                                 paddle.optimizer.Momentum(momentum=0.9))
+    trainer.train(paddle.batch(paddle.dataset.mnist.train(), 64),
+                  num_passes=2, event_handler=handler)
+"""
+
+from .. import batch, reader, dataset  # noqa: F401  (reader plumbing)
+from . import data_type  # noqa: F401
+from . import event  # noqa: F401
+from . import inference  # noqa: F401
+from . import layer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import parameters as _parameters_mod
+from . import trainer  # noqa: F401
+from .inference import infer  # noqa: F401
+
+parameters = _parameters_mod
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """Process bootstrap (reference paddle.init → swig initPaddle). Device
+    selection is JAX's here; accepted for script compatibility."""
+    return None

@@ -1,0 +1,71 @@
+"""v2 optimizers (python/paddle/v2/optimizer.py parity): thin wrappers that
+carry the config until the trainer appends the real fluid optimizer ops."""
+
+from .. import optimizer as fluid_optimizer
+
+
+class Optimizer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def _make(self):
+        raise NotImplementedError
+
+    def create_updater(self):
+        return self._make()
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self.learning_rate = learning_rate
+
+    def _make(self):
+        return fluid_optimizer.SGD(learning_rate=self.learning_rate)
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, learning_rate=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.learning_rate = learning_rate
+
+    def _make(self):
+        return fluid_optimizer.Momentum(learning_rate=self.learning_rate,
+                                        momentum=self.momentum)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _make(self):
+        return fluid_optimizer.Adam(learning_rate=self.learning_rate,
+                                    beta1=self.beta1, beta2=self.beta2,
+                                    epsilon=self.epsilon)
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.learning_rate = learning_rate
+        self.epsilon = epsilon
+
+    def _make(self):
+        return fluid_optimizer.Adagrad(learning_rate=self.learning_rate,
+                                       epsilon=self.epsilon)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.01, rho=0.95, epsilon=1e-6,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.learning_rate = learning_rate
+        self.rho, self.epsilon = rho, epsilon
+
+    def _make(self):
+        return fluid_optimizer.RMSProp(learning_rate=self.learning_rate,
+                                       rho=self.rho, epsilon=self.epsilon)

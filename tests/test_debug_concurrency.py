@@ -1,0 +1,164 @@
+"""Debugger dump (fluid/debuger.py parity) + CSP concurrency shim
+(framework/channel.h, go_op, select_op parity — incl. the reference's
+CSP fibonacci whole-program test, framework/channel_test.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import concurrency as csp
+from paddle_tpu import debugger
+
+
+def test_graphviz_dump_and_pprint(tmp_path):
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.fc(x, 2, act="relu")
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+
+    dot = debugger.draw_program(prog, path=str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+    assert "mul" in dot and "relu" in dot
+    assert '"x"' in dot or "x\\n" in dot
+    assert (tmp_path / "g.dot").read_text() == dot
+    # parameters shaded differently from activations
+    assert "#b3d9ff" in dot
+
+    code = debugger.pprint_program_codes(prog)
+    assert "// block 0" in code
+    assert "mul(" in code and "sgd(" in code
+
+
+def test_channel_buffered_send_recv_close():
+    ch = csp.make_channel(capacity=2)
+    assert csp.channel_send(ch, 1)
+    assert csp.channel_send(ch, 2)
+    v, ok = csp.channel_recv(ch)
+    assert (v, ok) == (1, True)
+    csp.channel_close(ch)
+    v, ok = csp.channel_recv(ch)
+    assert (v, ok) == (2, True)     # drain after close
+    v, ok = csp.channel_recv(ch)
+    assert ok is False
+    assert csp.channel_send(ch, 3) is False   # send on closed fails
+
+
+def test_channel_unbuffered_rendezvous():
+    ch = csp.make_channel(capacity=0)
+    got = []
+
+    def consumer():
+        for v in ch:
+            got.append(v)
+
+    t = csp.go(consumer)
+    for i in range(5):
+        ch.send(i)
+    ch.close()
+    t.join(timeout=5)
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_csp_fibonacci_whole_program():
+    # channel_test.cc / concurrency_test.cc: producer goroutine feeding a
+    # rendezvous channel; quit channel stops it
+    c = csp.make_channel(capacity=0)
+    quit_ch = csp.make_channel(capacity=0)
+
+    def fib():
+        x, y = 0, 1
+        while True:
+            sent = csp.select([
+                csp.case_send(c, x, action=lambda: "sent"),
+                csp.case_recv(quit_ch, action=lambda v, ok: "quit"),
+            ])
+            if sent == "quit":
+                return
+            x, y = y, x + y
+
+    csp.go(fib)
+    out = [c.recv()[0] for _ in range(10)]
+    quit_ch.send(None)
+    assert out == [0, 1, 1, 2, 3, 5, 8, 13, 21, 34]
+
+
+def test_select_first_ready():
+    a = csp.make_channel(capacity=1)
+    b = csp.make_channel(capacity=1)
+    b.send("from-b")
+    res = csp.select([
+        csp.case_recv(a, action=lambda v, ok: ("a", v)),
+        csp.case_recv(b, action=lambda v, ok: ("b", v)),
+    ], timeout=5)
+    assert res == ("b", "from-b")
+
+
+def test_go_pipeline_feeds_executor():
+    # the M6 use-case: a reader goroutine pumping batches through a channel
+    # into the compiled-step loop
+    x = fluid.layers.data("x", [4])
+    loss = fluid.layers.mean(fluid.layers.fc(x, 1))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    ch = csp.make_channel(capacity=4)
+
+    def producer():
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            ch.send(rng.rand(8, 4).astype(np.float32))
+        ch.close()
+
+    csp.go(producer)
+    losses = [float(exe.run(feed={"x": batch}, fetch_list=[loss])[0])
+              for batch in ch]
+    assert len(losses) == 6 and all(np.isfinite(l) for l in losses)
+
+
+def test_select_does_not_consume_from_losing_cases():
+    import threading
+    a = csp.make_channel(capacity=1)
+    b = csp.make_channel(capacity=1)
+    n0 = threading.active_count()
+    a.send("a1")
+    r1 = csp.select([
+        csp.case_recv(a, action=lambda v, ok: v),
+        csp.case_recv(b, action=lambda v, ok: v),
+    ], timeout=5)
+    assert r1 == "a1"
+    # a value sent to b AFTER round 1 must reach round 2 intact (no ghost
+    # thread from round 1 may steal it) and no threads may linger
+    b.send("b1")
+    r2 = csp.select([
+        csp.case_recv(a, action=lambda v, ok: v),
+        csp.case_recv(b, action=lambda v, ok: v),
+    ], timeout=5)
+    assert r2 == "b1"
+    assert threading.active_count() == n0
+
+
+def test_select_send_on_closed_channel_raises():
+    ch = csp.make_channel(capacity=1)
+    ch.close()
+    with pytest.raises(csp.ChannelClosed):
+        csp.select([csp.case_send(ch, 1, action=lambda: "sent")],
+                   timeout=1)
+
+
+def test_unbuffered_send_rendezvous_blocks_without_receiver():
+    import time
+    ch = csp.make_channel(capacity=0)
+    state = {"returned": False}
+
+    def sender():
+        ch.send("x")
+        state["returned"] = True
+
+    csp.go(sender)
+    time.sleep(0.2)
+    assert not state["returned"]    # no receiver yet -> send still parked
+    v, ok = ch.recv()
+    assert (v, ok) == ("x", True)
+    time.sleep(0.2)
+    assert state["returned"]

@@ -110,3 +110,30 @@ def test_feed_parallel_splits_whole_sequences():
     np.testing.assert_array_equal(np.asarray(p1.data).ravel(),
                                   [5, 6, 7, 8, 9])
     assert outs[0]["d"].shape == (2, 2) and outs[1]["d"].shape == (2, 2)
+
+
+def test_accuracy_masks_bucket_pad_rows():
+    pred = fluid.layers.data("pred", [3], lod_level=1)
+    lbl = fluid.layers.data("lbl", [1], dtype="int64", lod_level=1)
+    acc = fluid.layers.accuracy(pred, lbl)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # 6 rows pad to 8; pad labels are 0 and pad argmax could hit class 0 —
+    # they must count neither as correct nor in the total
+    p = np.zeros((6, 3), np.float32)
+    p[np.arange(6), [0, 1, 2, 0, 1, 2]] = 1.0        # argmax = pattern
+    lab = np.array([[0], [1], [0], [0], [2], [2]], np.int64)  # 4 hits
+    got, = exe.run(feed={"pred": _lod(p, [3, 3]), "lbl": _lod(lab, [3, 3])},
+                   fetch_list=[acc])
+    np.testing.assert_allclose(float(np.asarray(got).ravel()[0]), 4 / 6,
+                               rtol=1e-6)
+
+
+def test_reduce_max_keeps_integer_dtype_under_bucketing():
+    x = fluid.layers.data("x", [1], dtype="int64", lod_level=1)
+    mx = fluid.layers.reduce_max(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.array([[-5], [-2], [-9], [-1], [-7], [-3]], np.int64)
+    got, = exe.run(feed={"x": _lod(arr, [3, 3])}, fetch_list=[mx])
+    got = np.asarray(got)
+    assert got.dtype.kind == "i", got.dtype   # no silent float promotion
+    assert int(got.ravel()[0]) == -1          # pad zeros must not win

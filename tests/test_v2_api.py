@@ -1,0 +1,132 @@
+"""v2 façade tests (SURVEY.md M7): the reference v2 MNIST script shape —
+`SGD.train(reader, event_handler)` event loop, Parameters tar round-trip,
+test() without parameter updates, and paddle.v2.infer. Reference:
+python/paddle/v2/trainer.py:37,137, v2/parameters.py, book
+recognize_digits v2 scripts."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+import paddle_tpu as fluid
+
+
+def _toy_reader(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(n, 8).astype(np.float32)
+    w = rng.rand(8).astype(np.float32)
+    ys = (xs @ w > w.sum() / 2).astype(np.int64)
+
+    def reader():
+        for i in range(n):
+            yield xs[i], int(ys[i])
+    return reader
+
+
+def _build_classifier():
+    images = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    hidden = paddle.layer.fc(images, 16, act="tanh")
+    predict = paddle.layer.fc(hidden, 2, act="softmax")
+    cost = paddle.layer.classification_cost(predict, label)
+    return cost, predict
+
+
+def test_v2_event_loop_trains_and_fires_events():
+    paddle.init(use_gpu=False)
+    cost, predict = _build_classifier()
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    events = {"begin_pass": 0, "end_pass": 0, "iters": 0}
+    costs = []
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.BeginPass):
+            events["begin_pass"] += 1
+        elif isinstance(event, paddle.event.EndPass):
+            events["end_pass"] += 1
+            costs.append(event.cost)
+        elif isinstance(event, paddle.event.EndIteration):
+            events["iters"] += 1
+            assert np.isfinite(event.cost)
+
+    trainer.train(paddle.batch(_toy_reader(), batch_size=16),
+                  num_passes=8, event_handler=event_handler)
+    assert events["begin_pass"] == events["end_pass"] == 8
+    assert events["iters"] == 8 * 8
+    assert costs[-1] < costs[0] * 0.5, costs
+
+    # parameters view holds real trained arrays
+    assert len(parameters.keys()) >= 2
+    for name in parameters:
+        assert np.isfinite(parameters[name]).all()
+
+    # test() leaves parameters untouched
+    before = {n: parameters[n].copy() for n in parameters}
+    result = trainer.test(paddle.batch(_toy_reader(seed=1), batch_size=16))
+    assert np.isfinite(result.cost)
+    for n in parameters:
+        np.testing.assert_array_equal(parameters[n], before[n])
+
+    # tar round-trip + infer parity (v2 parameters.to_tar / from_tar)
+    buf = io.BytesIO()
+    trainer.save_parameter_to_tar(buf)
+    buf.seek(0)
+    restored = paddle.parameters.Parameters.from_tar(buf)
+    for n in parameters:
+        np.testing.assert_array_equal(parameters[n], restored[n])
+
+    probe = [tuple([np.random.RandomState(7).rand(8).astype(np.float32)])]
+    p1 = paddle.infer(output_layer=predict, parameters=parameters,
+                      input=probe)
+    p2 = paddle.infer(output_layer=predict, parameters=restored,
+                      input=probe)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+    assert p1.shape == (1, 2)
+
+
+def test_v2_sequence_layers_compose():
+    words = paddle.layer.data(
+        "words", paddle.data_type.integer_value_sequence(50))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(words, size=8)
+    pooled = paddle.layer.pooling(emb, pooling_type="max")
+    predict = paddle.layer.fc(pooled, 2, act="softmax")
+    cost = paddle.layer.classification_cost(predict, label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(32):
+        n = rng.randint(2, 6)
+        seq = rng.randint(0, 50, n).tolist()
+        samples.append((seq, int(seq[0] % 2)))
+
+    def reader():
+        yield from samples
+
+    seen = []
+    trainer.train(paddle.batch(reader, batch_size=8), num_passes=10,
+                  event_handler=lambda e: seen.append(e.cost)
+                  if isinstance(e, paddle.event.EndPass) else None)
+    assert seen[-1] < seen[0] * 0.7, seen
+
+
+def test_v2_type_errors():
+    cost, _ = _build_classifier()
+    parameters = paddle.parameters.create(cost)
+    with pytest.raises(TypeError):
+        paddle.trainer.SGD(cost, {"not": "parameters"},
+                           paddle.optimizer.SGD())
+    with pytest.raises(TypeError):
+        paddle.trainer.SGD(cost, parameters, "not-an-optimizer")
+    with pytest.raises(TypeError):
+        paddle.layer.data("x", [8])   # fluid-style shape is not a v2 type
