@@ -55,6 +55,7 @@ from . import nets  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
+from . import recordio  # noqa: F401
 from . import concurrency  # noqa: F401
 from .transpiler import (  # noqa: F401
     InferenceTranspiler, memory_optimize, release_memory,

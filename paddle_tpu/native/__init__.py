@@ -1,0 +1,51 @@
+"""Native (C++) runtime components, built lazily with the in-tree
+Makefile and bound via ctypes (pybind11 is not available in this image;
+the C ABI keeps the boundary minimal anyway).
+
+Components:
+- librecordio.so — chunked+CRC+DEFLATE record file format
+  (recordio/recordio.cc; reference capability paddle/fluid/recordio/).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build(target):
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, os.path.join("build", target)],
+            check=True, capture_output=True, text=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        out = getattr(e, "stderr", "") or str(e)
+        raise NativeBuildError(
+            "failed to build native %s (need g++ and zlib): %s"
+            % (target, out.strip()[-800:])) from e
+
+
+def load(name):
+    """Load (building if necessary) lib<name>.so; cached per process."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        target = "lib%s.so" % name
+        path = os.path.join(_BUILD, target)
+        src = os.path.join(_DIR, name, "%s.cc" % name)
+        if not os.path.exists(path) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(path)):
+            _build(target)
+        lib = ctypes.CDLL(path)
+        _LIBS[name] = lib
+        return lib
