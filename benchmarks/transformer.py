@@ -17,10 +17,14 @@ def main():
             p.add_argument("--n_head", type=int, default=8),
             p.add_argument("--d_model", type=int, default=512),
             p.add_argument("--d_inner", type=int, default=2048),
-            p.add_argument("--vocab", type=int, default=8192)))
+            p.add_argument("--vocab", type=int, default=8192),
+            p.add_argument("--packed", type=int, default=1,
+                           help="full-length packed sequences (flash "
+                                "attention fused path)")))
     avg_cost, _ = T.transformer_lm(
         vocab_size=args.vocab, max_len=args.max_len, n_layer=args.n_layer,
-        n_head=args.n_head, d_model=args.d_model, d_inner=args.d_inner)
+        n_head=args.n_head, d_model=args.d_model, d_inner=args.d_inner,
+        packed=bool(args.packed))
     fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     if args.dtype == "bfloat16":
         fluid.amp.enable_amp()
@@ -29,7 +33,14 @@ def main():
 
     rng = np.random.RandomState(0)
     feeds = T.make_lm_batch(rng, args.batch_size, args.max_len, args.vocab)
+    if args.packed:
+        feeds["mask"] = np.ones_like(feeds["mask"])
     tokens_per_batch = int(feeds["mask"].sum())
+    # analytic train FLOPs/token (3x fwd): per layer 8d^2 (qkvo) +
+    # 4*d*d_inner (ffn) + 4*T*d (attention); head 2*d*V
+    d, t = args.d_model, args.max_len
+    flops_tok = 3 * (args.n_layer * (8 * d * d + 4 * d * args.d_inner
+                                     + 4 * t * d) + 2 * d * args.vocab)
     total = args.iterations + args.skip_batch_num
     loader = iter(fluid.reader.DeviceLoader(
         fluid.reader.repeat_feed(feeds, total + 1)))
@@ -44,7 +55,12 @@ def main():
     def sync():
         print("loss %.4f" % float(np.asarray(last[0])))
 
-    return time_loop(step, args, tokens_per_batch, "tokens", sync=sync)
+    tps = time_loop(step, args, tokens_per_batch, "tokens", sync=sync)
+    import sys
+    print("MFU %.1f%% (%.0f tok/s x %.1f MFLOP/tok / 197 TFLOP/s peak)"
+          % (tps * flops_tok / 197e12 * 100, tps, flops_tok / 1e6),
+          file=sys.stderr)
+    return tps
 
 
 if __name__ == "__main__":

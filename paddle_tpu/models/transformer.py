@@ -27,9 +27,15 @@ def position_encoding_init(n_position, d_model):
 
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
-                         d_model, n_head=1, dropout_rate=0.0):
+                         d_model, n_head=1, dropout_rate=0.0,
+                         causal=False):
     """queries/keys/values: [B, T, D]; attn_bias: [B, n_head, Tq, Tk] addend
-    (−inf at masked positions) or None."""
+    (−inf at masked positions) or None.
+
+    `causal=True` with no bias and no attention dropout takes the FUSED
+    path: the sp_attention op, whose local lowering is the Pallas flash
+    kernel on TPU (ops/flash_attention.py) — no [T, T] score tensor in
+    HBM. Arbitrary biases keep the composed matmul+softmax form."""
     q = layers.fc(queries, d_key * n_head, num_flatten_dims=2,
                   bias_attr=False)
     k = layers.fc(keys, d_key * n_head, num_flatten_dims=2, bias_attr=False)
@@ -45,14 +51,25 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     k = split_heads(k, d_key)
     v = split_heads(v, d_value)
 
-    product = layers.matmul(layers.scale(q, d_key ** -0.5), k,
-                            transpose_y=True)             # [B, H, Tq, Tk]
-    if attn_bias is not None:
-        product = layers.elementwise_add(product, attn_bias)
-    weights = layers.softmax(product)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    ctx = layers.matmul(weights, v)                       # [B, H, Tq, dv]
+    if causal and attn_bias is None and not dropout_rate:
+        ctx = layers.sequence_parallel_attention(q, k, v, causal=True)
+    else:
+        if causal:
+            # fused-path preconditions not met (dropout/bias): the
+            # composed form must still mask the future
+            t = q.shape[2]
+            tri = np.triu(np.ones((t, t), np.float32), k=1) * -1e9
+            tri_var = layers.assign(tri.reshape(1, 1, t, t))
+            attn_bias = tri_var if attn_bias is None else \
+                layers.elementwise_add(attn_bias, tri_var)
+        product = layers.matmul(layers.scale(q, d_key ** -0.5), k,
+                                transpose_y=True)         # [B, H, Tq, Tk]
+        if attn_bias is not None:
+            product = layers.elementwise_add(product, attn_bias)
+        weights = layers.softmax(product)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate)
+        ctx = layers.matmul(weights, v)                   # [B, H, Tq, dv]
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     b, t = ctx.shape[0], ctx.shape[1]
     ctx = layers.reshape(ctx, [b, t, n_head * d_value])
@@ -89,9 +106,11 @@ def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
 
 
 def decoder_layer(x, enc_output, slf_attn_bias, dec_enc_attn_bias, n_head,
-                  d_key, d_value, d_model, d_inner, dropout_rate=0.0):
+                  d_key, d_value, d_model, d_inner, dropout_rate=0.0,
+                  causal=False):
     slf = multi_head_attention(x, x, x, slf_attn_bias, d_key, d_value,
-                               d_model, n_head, dropout_rate)
+                               d_model, n_head, dropout_rate,
+                               causal=causal)
     slf_out = pre_post_process_layer(x, slf, "dan", dropout_rate)
     if enc_output is not None:
         cross = multi_head_attention(slf_out, enc_output, enc_output,
@@ -138,10 +157,15 @@ def make_attn_bias(mask_2d, n_head, causal=False, seq_len=None):
 
 def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
                    d_model=512, d_inner=2048, dropout_rate=0.0,
-                   label_smooth_eps=0.0):
+                   label_smooth_eps=0.0, packed=False):
     """Decoder-only LM (flagship bench model). Feeds: src [B,T] int64,
     pos [B,T] int64, mask [B,T] float32, label [B,T] int64.
-    Returns (avg_cost, logits)."""
+    Returns (avg_cost, logits).
+
+    packed=True assumes full-length (packed) sequences — the standard LM
+    pretraining layout — and drops the padding half of the attention bias
+    so self-attention runs through the fused flash path; `mask` still
+    weights the loss."""
     d_key = d_value = d_model // n_head
     src = layers.data("src", [max_len], dtype="int64")
     pos = layers.data("pos", [max_len], dtype="int64")
@@ -151,10 +175,11 @@ def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
     x = _embed(src, vocab_size, d_model, max_len, pos, "lm")
     if dropout_rate:
         x = layers.dropout(x, dropout_prob=dropout_rate)
-    bias = make_attn_bias(mask, n_head, causal=True)
+    bias = None if packed else make_attn_bias(mask, n_head, causal=True)
     for _ in range(n_layer):
         x = decoder_layer(x, None, bias, None, n_head, d_key, d_value,
-                          d_model, d_inner, dropout_rate)
+                          d_model, d_inner, dropout_rate,
+                          causal=packed)
     logits = layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False)
 
     b, t = logits.shape[0], logits.shape[1]

@@ -195,12 +195,17 @@ int rio_writer_write(void* handle, const char* buf, uint64_t len) {
     set_error("record too large (u32 length prefix)");
     return -1;
   }
-  put_u32(&w->raw, uint32_t(len));
-  w->raw.append(buf, len);
-  w->num_records += 1;
-  w->total_records += 1;
-  if (w->raw.size() >= w->max_chunk_bytes) {
-    if (!w->flush_chunk()) return -1;
+  try {  // bad_alloc etc. must not unwind through the C ABI
+    put_u32(&w->raw, uint32_t(len));
+    w->raw.append(buf, len);
+    w->num_records += 1;
+    w->total_records += 1;
+    if (w->raw.size() >= w->max_chunk_bytes) {
+      if (!w->flush_chunk()) return -1;
+    }
+  } catch (const std::exception& e) {
+    set_error(std::string("write failed: ") + e.what());
+    return -1;
   }
   return 0;
 }
@@ -208,7 +213,12 @@ int rio_writer_write(void* handle, const char* buf, uint64_t len) {
 uint64_t rio_writer_close(void* handle) {
   Writer* w = static_cast<Writer*>(handle);
   uint64_t total = w->total_records;
-  bool ok = w->flush_chunk();
+  bool ok = false;
+  try {
+    ok = w->flush_chunk();
+  } catch (const std::exception& e) {
+    set_error(std::string("flush failed: ") + e.what());
+  }
   fclose(w->f);
   delete w;
   return ok ? total : uint64_t(-1);
@@ -230,6 +240,14 @@ void* rio_scanner_open(const char* path) {
 const char* rio_scanner_next(void* handle, uint64_t* len) {
   Scanner* s = static_cast<Scanner*>(handle);
   if (s->remaining == 0) {
+    // the header's num_records is outside the payload CRC: an understated
+    // count would silently drop trailing records unless the cursor is
+    // checked against the chunk end here
+    if (!s->raw.empty() && s->pos != s->raw.size()) {
+      set_error("trailing bytes in chunk (corrupt record count)");
+      *len = uint64_t(-1);
+      return nullptr;
+    }
     g_error.clear();
     bool ok = false;
     try {

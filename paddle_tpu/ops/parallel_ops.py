@@ -35,15 +35,10 @@ def _batch_axis(mesh):
 
 
 def _dense_attention(q, k, v, causal, scale):
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        t = s.shape[-1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(p.dtype)).astype(q.dtype)
+    # routes to the Pallas flash kernel on TPU (streaming softmax, no
+    # [T, T] HBM materialization); dense XLA math elsewhere
+    from .flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal, scale=scale)
 
 
 @register("sp_attention")

@@ -192,3 +192,19 @@ def test_reader_early_abandon_does_not_leak_fds(tmp_path):
         it.close()        # generator close triggers the finally
     gc.collect()
     assert len(os.listdir("/proc/self/fd")) <= n0 + 1
+
+
+def test_understated_record_count_detected(tmp_path):
+    # num_records is outside the payload CRC; an understated count must
+    # raise instead of silently dropping trailing records
+    path = str(tmp_path / "cnt.rio")
+    with recordio.Writer(path, compressor=recordio.COMPRESSOR_NONE) as w:
+        for i in range(5):
+            w.write(b"rec%d" % i)
+    blob = bytearray(open(path, "rb").read())
+    assert blob[6] == 5            # num_records low byte
+    blob[6] = 3
+    open(path, "wb").write(bytes(blob))
+    s = recordio.Scanner(path)
+    with pytest.raises(IOError):
+        list(s)
