@@ -54,7 +54,7 @@ def main():
 
     _run(["--batch_size", "32", "--iterations", "15",
           "--skip_batch_num", "3", "--device", "TPU",
-          "--dtype", "float32"])
+          "--dtype", "bfloat16"])
     try:
         from transformer import main as transformer_main
         tps = float(transformer_main())

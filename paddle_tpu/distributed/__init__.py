@@ -13,3 +13,4 @@ Two layers, per SURVEY.md §5.8 / §7:
 from .rpc import VariableServer, RPCClient  # noqa: F401
 from .transpiler import DistributeTranspiler  # noqa: F401
 from . import ops  # noqa: F401  (registers host ops)
+from . import launch  # noqa: F401

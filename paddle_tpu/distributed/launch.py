@@ -1,0 +1,59 @@
+"""Multi-host bootstrap for mesh-mode training.
+
+Reference parity: the env-driven trainer bootstrap of the pserver world
+(distribute_transpiler.py trainer_id/trainers envs; paddle.init) mapped to
+the TPU-native path — jax.distributed.initialize builds the cross-host
+process group, after which a Mesh spanning all hosts' devices gives DCN+ICI
+collectives through the same GSPMD programs (SURVEY §5.8: jax.distributed
++ coordination service replace etcd rendezvous for mesh mode; the
+pserver/elastic tier remains the explicitly-managed alternative).
+
+Env contract (PADDLE_* names kept for reference-script compatibility):
+  PADDLE_COORDINATOR   host:port of process 0 (jax coordination service)
+  PADDLE_TRAINERS_NUM  total process count
+  PADDLE_TRAINER_ID    this process's rank
+"""
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None, local_device_ids=None):
+    """Idempotent process-group init. With no arguments and no PADDLE_*
+    env, single-process mode is a no-op (matching paddle.init locally)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or \
+        os.environ.get("PADDLE_COORDINATOR")
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if num_processes <= 1 and coordinator_address is None:
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def global_mesh(axes):
+    """Mesh over ALL processes' devices (call after init_parallel_env).
+    `axes`: dict name -> size, like parallel.make_mesh but global."""
+    from ..parallel.mesh import make_mesh
+    return make_mesh(axes, devices=jax.devices())
+
+
+def trainer_id():
+    return jax.process_index()
+
+
+def trainer_count():
+    return jax.process_count()

@@ -56,10 +56,22 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     else:
         if causal:
             # fused-path preconditions not met (dropout/bias): the
-            # composed form must still mask the future
+            # composed form must still mask the future. The T^2 constant
+            # is created once per (block, T) and shared by every layer
+            # instead of materializing a fresh triu per call.
             t = q.shape[2]
-            tri = np.triu(np.ones((t, t), np.float32), k=1) * -1e9
-            tri_var = layers.assign(tri.reshape(1, 1, t, t))
+            blk = q.block
+            cname = "causal_bias_%d" % t
+            if blk.has_var(cname):
+                tri_var = blk.var(cname)
+            else:
+                tri = np.triu(np.ones((t, t), np.float32), k=1) * -1e9
+                tri_var = blk.create_var(name=cname, shape=(1, 1, t, t),
+                                         dtype="float32")
+                blk.append_op(
+                    "assign_value", {}, {"Out": [cname]},
+                    {"shape": [1, 1, t, t], "dtype": "float32",
+                     "values": tri.reshape(-1).tolist()})
             attn_bias = tri_var if attn_bias is None else \
                 layers.elementwise_add(attn_bias, tri_var)
         product = layers.matmul(layers.scale(q, d_key ** -0.5), k,

@@ -137,3 +137,41 @@ def _edit_distance(ctx, op):
         dists = dists / jnp.maximum(rls.astype(jnp.float32), 1.0)
     ctx.set_out(op, "Out", dists.reshape(-1, 1))
     ctx.set_out(op, "SequenceNum", jnp.asarray([hyp.shape[0]], I64()))
+
+
+@register("positive_negative_pair")
+def _positive_negative_pair(ctx, op):
+    """Ranking-pair metric (operators/positive_negative_pair_op.h): within
+    each query, a pair with label_i > label_j is positive when
+    score_i > score_j, negative when <, neutral when ==. Optional
+    accumulator inputs carry totals across batches."""
+    score = ctx.in1(op, "Score").reshape(-1)
+    label = ctx.in1(op, "Label").reshape(-1)
+    qid = ctx.in1(op, "QueryID").reshape(-1)
+    col = int(op.attr("column", -1))
+    s2 = ctx.in1(op, "Score")
+    if s2.ndim == 2 and s2.shape[1] > 1:
+        score = s2[:, col]
+
+    # optional per-row weight; a pair weighs (w_i + w_j) / 2 (reference
+    # positive_negative_pair_op.h). NB the reference also adds equal-score
+    # pairs to the negative count alongside neutral (a double-count its
+    # own tests don't pin down); here the three counts are disjoint.
+    w = ctx.in1(op, "Weight")
+    w = jnp.ones_like(score) if w is None else w.reshape(-1)
+    pair_w = (w[:, None] + w[None, :]) * 0.5
+
+    same_q = qid[:, None] == qid[None, :]
+    lab_gt = label[:, None] > label[None, :]
+    considered = same_q & lab_gt          # ordered pairs, counted once
+    sc_d = score[:, None] - score[None, :]
+    pos = jnp.sum(jnp.where(considered & (sc_d > 0), pair_w, 0.0))
+    neg = jnp.sum(jnp.where(considered & (sc_d < 0), pair_w, 0.0))
+    neu = jnp.sum(jnp.where(considered & (sc_d == 0), pair_w, 0.0))
+
+    acc_p = ctx.in1(op, "AccumulatePositivePair", jnp.zeros((1,)))
+    acc_n = ctx.in1(op, "AccumulateNegativePair", jnp.zeros((1,)))
+    acc_u = ctx.in1(op, "AccumulateNeutralPair", jnp.zeros((1,)))
+    ctx.set_out(op, "PositivePair", pos.reshape(1) + acc_p.reshape(1))
+    ctx.set_out(op, "NegativePair", neg.reshape(1) + acc_n.reshape(1))
+    ctx.set_out(op, "NeutralPair", neu.reshape(1) + acc_u.reshape(1))

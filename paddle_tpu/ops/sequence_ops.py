@@ -284,3 +284,35 @@ def _sequence_scatter(ctx, op):
     ids = ctx.in1(op, "Ids").reshape(-1).astype(jnp.int32)
     updates = ctx.in1(op, "Updates")
     ctx.set_out(op, "Out", x.at[ids].add(updates))
+
+
+@register("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(ctx, op):
+    """Reorder X's sequences into the rank table's order — decreasing
+    length, stable (framework/lod_rank_table.h + operators/
+    reorder_lod_tensor_by_rank_op.cc). The rank table here is the lengths
+    vector produced by the lod_rank_table op."""
+    x = ctx.in1(op, "X")
+    table = ctx.in1(op, "RankTable").reshape(-1)
+    lengths = _lengths(ctx, op)
+    if lengths is None:
+        # LoD-less X: one sequence per ROW — reorder rows by the table
+        # order (reorder_lod_tensor_by_rank_op.cc non-LoD branch)
+        order = jnp.argsort(-table, stable=True)
+        ctx.set_out(op, "Out", x[order])
+        return
+    t = x.shape[0]
+    order = jnp.argsort(-table, stable=True)     # new rank -> old seq idx
+    inv = jnp.argsort(order, stable=True)        # old seq idx -> new rank
+    new_lens = lengths[order]
+    new_starts = jnp.cumsum(new_lens) - new_lens
+    starts = _starts(lengths)
+    seg = _segments(lengths, t)
+    pos_in_seq = jnp.arange(t) - starts[jnp.clip(seg, 0, len(lengths) - 1)]
+    seg_c = jnp.clip(seg, 0, len(lengths) - 1)
+    new_row = new_starts[inv[seg_c]] + pos_in_seq
+    # pad rows (seg == n) park at their own index (identity)
+    new_row = jnp.where(seg < len(lengths), new_row, jnp.arange(t))
+    out = jnp.zeros_like(x).at[new_row].set(x)
+    ctx.set_out(op, "Out", out)
+    _set_out_lod(ctx, op, new_lens)

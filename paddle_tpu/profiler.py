@@ -16,9 +16,11 @@ import time
 from collections import defaultdict
 
 __all__ = ["profiler", "tpu_profiler", "cuda_profiler", "reset_profiler",
-           "start_profiler", "stop_profiler", "RecordEvent"]
+           "start_profiler", "stop_profiler", "RecordEvent",
+           "export_chrome_trace"]
 
 _events = defaultdict(lambda: [0, 0.0])   # name -> [count, total_s]
+_trace = []                               # (name, start_s, dur_s, thread)
 _enabled = False
 
 
@@ -35,14 +37,35 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         if _enabled:
+            now = time.perf_counter()
             ev = _events[self.name]
             ev[0] += 1
-            ev[1] += time.perf_counter() - self._t0
+            ev[1] += now - self._t0
+            import threading
+            _trace.append((self.name, self._t0, now - self._t0,
+                           threading.get_ident()))
         return False
 
 
 def reset_profiler():
     _events.clear()
+    del _trace[:]
+
+
+def export_chrome_trace(path):
+    """Write recorded events as a chrome://tracing / Perfetto JSON file
+    (tools/timeline.py parity — the reference converts its profiler.proto
+    Profile with _ChromeTraceFormatter; here host events convert directly;
+    device-side traces come from tpu_profiler's XPlane output)."""
+    import json
+    events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
+               "ts": start * 1e6, "dur": dur * 1e6,
+               "cat": "host"}
+              for name, start, dur, tid in _trace]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(events)
 
 
 def start_profiler(state="All"):

@@ -162,3 +162,29 @@ def test_unbuffered_send_rendezvous_blocks_without_receiver():
     assert (v, ok) == ("x", True)
     time.sleep(0.2)
     assert state["returned"]
+
+
+def test_chrome_trace_export(tmp_path):
+    import json
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.RecordEvent("step"):
+        with profiler.RecordEvent("inner"):
+            pass
+    profiler.stop_profiler(profile_path=str(tmp_path / "p.txt"))
+    n = profiler.export_chrome_trace(str(tmp_path / "trace.json"))
+    assert n == 2
+    data = json.loads((tmp_path / "trace.json").read_text())
+    names = {e["name"] for e in data["traceEvents"]}
+    assert names == {"step", "inner"}
+    assert all(e["ph"] == "X" and "dur" in e for e in data["traceEvents"])
+
+
+def test_init_parallel_env_single_process_noop():
+    from paddle_tpu.distributed import launch
+    launch.init_parallel_env()           # no env, 1 process: no-op
+    assert launch.trainer_count() >= 1
+    assert launch.trainer_id() == 0
+    mesh = launch.global_mesh({"dp": 8})
+    assert mesh.shape["dp"] == 8

@@ -854,3 +854,75 @@ def test_print_op_smoke():
     x = _r(2, 2, seed=178)
     got = run_op("print", {"In": x}, {"message": "sweep"}, ["Out"])
     np.testing.assert_allclose(np.asarray(got["Out"]), x)
+
+
+def test_positive_negative_pair_op():
+    # query 0: labels 2,1 scores 0.9,0.4 -> positive; query 1: labels
+    # (2,1),(2,0),(1,0): one wrong order -> 2 pos 1 neg
+    score = np.array([[0.9], [0.4], [0.3], [0.7], [0.5]], np.float32)
+    label = np.array([[2], [1], [2], [1], [0]], np.float32)
+    qid = np.array([[0], [0], [1], [1], [1]], np.int64)
+    got = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": qid}, {},
+                 ["PositivePair", "NegativePair", "NeutralPair"])
+    assert float(np.asarray(got["PositivePair"])[0]) == 2.0
+    assert float(np.asarray(got["NegativePair"])[0]) == 2.0
+    assert float(np.asarray(got["NeutralPair"])[0]) == 0.0
+
+
+def test_reorder_lod_tensor_by_rank_op():
+    prog = fluid.Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", shape=(6, 2), dtype="float32", is_data=True,
+                   lod_level=1)
+    blk.create_var(name="table")
+    blk.append_op("lod_rank_table", {"X": ["x"]}, {"Out": ["table"]}, {})
+    blk.create_var(name="out")
+    blk.append_op("reorder_lod_tensor_by_rank",
+                  {"X": ["x"], "RankTable": ["table"]}, {"Out": ["out"]},
+                  {})
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    t = fluid.LoDTensor(x)
+    t.set_recursive_sequence_lengths([[2, 4]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        out, = exe.run(prog, feed={"x": t}, fetch_list=["out"])
+    # longer sequence (rows 2..5) first, then rows 0..1
+    want = np.concatenate([x[2:], x[:2]])
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_positive_negative_pair_weighted():
+    score = np.array([[0.9], [0.4]], np.float32)
+    label = np.array([[2], [1]], np.float32)
+    qid = np.array([[0], [0]], np.int64)
+    weight = np.array([[3.0], [1.0]], np.float32)
+    got = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": qid,
+                  "Weight": weight}, {}, ["PositivePair", "NegativePair"])
+    # one correctly-ordered pair with weight (3+1)/2
+    assert float(np.asarray(got["PositivePair"])[0]) == 2.0
+    assert float(np.asarray(got["NegativePair"])[0]) == 0.0
+
+
+def test_reorder_lod_tensor_by_rank_rowwise():
+    # LoD-less X: rows reorder by the rank table's decreasing-length order
+    prog = fluid.Program()
+    blk = prog.global_block()
+    blk.create_var(name="seq", shape=(5, 1), dtype="float32", is_data=True,
+                   lod_level=1)
+    blk.create_var(name="x", shape=(2, 3), dtype="float32", is_data=True)
+    blk.create_var(name="table")
+    blk.append_op("lod_rank_table", {"X": ["seq"]}, {"Out": ["table"]}, {})
+    blk.create_var(name="out")
+    blk.append_op("reorder_lod_tensor_by_rank",
+                  {"X": ["x"], "RankTable": ["table"]}, {"Out": ["out"]},
+                  {})
+    seq = fluid.LoDTensor(np.zeros((5, 1), np.float32))
+    seq.set_recursive_sequence_lengths([[2, 3]])
+    x = np.array([[1, 1, 1], [2, 2, 2]], np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        out, = exe.run(prog, feed={"seq": seq, "x": x},
+                       fetch_list=["out"])
+    np.testing.assert_allclose(np.asarray(out), x[[1, 0]])
