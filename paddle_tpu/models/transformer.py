@@ -71,7 +71,9 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                 blk.append_op(
                     "assign_value", {}, {"Out": [cname]},
                     {"shape": [1, 1, t, t], "dtype": "float32",
-                     "values": tri.reshape(-1).tolist()})
+                     # ndarray attr (serialized natively) — a .tolist()
+                     # would box T^2 python floats
+                     "values": tri.reshape(1, 1, t, t)})
             attn_bias = tri_var if attn_bias is None else \
                 layers.elementwise_add(attn_bias, tri_var)
         product = layers.matmul(layers.scale(q, d_key ** -0.5), k,

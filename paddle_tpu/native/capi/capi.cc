@@ -1,0 +1,188 @@
+// C inference API — the non-Python deployment entry point.
+//
+// Reference capability: paddle/capi/gradient_machine.h:36-62
+// (paddle_gradient_machine_create_for_inference / _forward): a C program
+// loads a trained model and runs forward passes. Here the engine is
+// XLA-through-JAX, so the C ABI embeds a CPython interpreter and drives
+// the same `fluid.io.load_inference_model` + Executor path a Python
+// deployment would use — one process, one interpreter, no IPC. The C
+// surface stays engine-agnostic: floats in, floats out.
+//
+//   void* pt_predictor_create(const char* model_dir);
+//   int   pt_predictor_run(void* p,
+//                          const float* in, const int64_t* shape, int nd,
+//                          float* out, int64_t out_cap,
+//                          int64_t* out_shape, int* out_nd);
+//   void  pt_predictor_destroy(void* p);
+//   const char* pt_last_error();
+//
+// Single-feed single-fetch (the common serving shape); multi-io can layer
+// on the same mechanism. Thread-safety: calls serialize on the GIL.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_error;
+
+void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  g_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+const char kHelper[] = R"PY(
+import os
+import numpy as np
+import paddle_tpu as fluid
+
+class _CPredictor:
+    """Holds a loaded inference program + scope; run() takes/returns
+    float32 numpy arrays (fluid.io.load_inference_model serving path)."""
+
+    def __init__(self, model_dir):
+        self.scope = fluid.Scope()
+        self.exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(self.scope):
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                model_dir, self.exe)
+        self.prog, self.feeds, self.fetches = prog, feeds, fetches
+
+    def run(self, buf, shape):
+        # zero-copy in: `buf` is a C memoryview over the caller's floats
+        x = np.frombuffer(buf, np.float32).reshape(shape).copy()
+        with fluid.scope_guard(self.scope):
+            out, = self.exe.run(self.prog, feed={self.feeds[0]: x},
+                                fetch_list=self.fetches)
+        out = np.ascontiguousarray(np.asarray(out), np.float32)
+        return out.tobytes(), list(out.shape)
+)PY";
+
+struct Predictor {
+  PyObject* obj;  // _CPredictor instance
+};
+
+PyObject* g_namespace = nullptr;
+
+bool g_we_initialized = false;
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  if (g_namespace == nullptr) {
+    PyObject* main_mod = PyImport_AddModule("__main__");
+    g_namespace = PyModule_GetDict(main_mod);
+    Py_INCREF(g_namespace);
+    PyObject* r = PyRun_String(kHelper, Py_file_input, g_namespace,
+                               g_namespace);
+    if (r == nullptr) {
+      set_error_from_python();
+      Py_CLEAR(g_namespace);
+      return false;
+    }
+    Py_DECREF(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* pt_last_error() { return g_error.c_str(); }
+
+void* pt_predictor_create(const char* model_dir) {
+  bool had_python = Py_IsInitialized();
+  PyGILState_STATE gil = PyGILState_LOCKED;
+  if (had_python) gil = PyGILState_Ensure();
+  void* result = nullptr;
+  bool ok = ensure_python();
+  if (ok) {
+    PyObject* cls = PyDict_GetItemString(g_namespace, "_CPredictor");
+    PyObject* obj =
+        cls ? PyObject_CallFunction(cls, "s", model_dir) : nullptr;
+    if (obj == nullptr) {
+      set_error_from_python();
+    } else {
+      Predictor* p = new Predictor{obj};
+      result = p;
+    }
+  }
+  if (had_python) {
+    PyGILState_Release(gil);
+  } else if (ok || g_we_initialized) {
+    // we created the interpreter on this thread: release the GIL so other
+    // threads' PyGILState_Ensure can proceed (serving pattern: create on
+    // main, run on workers)
+    PyEval_SaveThread();
+  }
+  return result;
+}
+
+int pt_predictor_run(void* handle, const float* in, const int64_t* shape,
+                     int nd, float* out, int64_t out_cap,
+                     int64_t* out_shape, int* out_nd) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  int64_t n = 1;
+  for (int i = 0; i < nd; ++i) n *= shape[i];
+
+  // buffer-protocol marshalling: no per-element boxing on the hot path
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(reinterpret_cast<const char*>(in)),
+      n * int64_t(sizeof(float)), PyBUF_READ);
+  PyObject* shp = PyList_New(nd);
+  for (int i = 0; i < nd; ++i) {
+    PyList_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* res = PyObject_CallMethod(p->obj, "run", "OO", mv, shp);
+  Py_DECREF(mv);
+  Py_DECREF(shp);
+  if (res == nullptr) {
+    set_error_from_python();
+  } else {
+    PyObject* vals = PyTuple_GetItem(res, 0);  // bytes
+    PyObject* oshp = PyTuple_GetItem(res, 1);
+    char* data = nullptr;
+    Py_ssize_t nbytes = 0;
+    PyBytes_AsStringAndSize(vals, &data, &nbytes);
+    int64_t out_n = nbytes / int64_t(sizeof(float));
+    int ond = int(PyList_Size(oshp));
+    if (out_n > out_cap) {
+      g_error = "output buffer too small";
+    } else {
+      memcpy(out, data, size_t(nbytes));
+      for (int i = 0; i < ond && i < 8; ++i) {
+        out_shape[i] = PyLong_AsLongLong(PyList_GetItem(oshp, i));
+      }
+      *out_nd = ond;
+      rc = 0;
+    }
+    Py_DECREF(res);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void pt_predictor_destroy(void* handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+}  // extern "C"

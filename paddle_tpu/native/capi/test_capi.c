@@ -1,0 +1,48 @@
+/* C deployment smoke test: load a saved inference model and run one
+ * forward pass from pure C (the reference's capi/examples role).
+ * Usage: test_capi <model_dir> <feature_dim>  — prints OUT followed by the
+ * output values for an all-ones input row. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pt_predictor_create(const char* model_dir);
+extern int pt_predictor_run(void* p, const float* in, const int64_t* shape,
+                            int nd, float* out, int64_t out_cap,
+                            int64_t* out_shape, int* out_nd);
+extern void pt_predictor_destroy(void* p);
+extern const char* pt_last_error(void);
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <dim>\n", argv[0]);
+    return 2;
+  }
+  int dim = atoi(argv[2]);
+  if (dim < 1 || dim > 512) {
+    fprintf(stderr, "dim must be in [1, 512]\n");
+    return 2;
+  }
+  void* p = pt_predictor_create(argv[1]);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", pt_last_error());
+    return 1;
+  }
+  float in[512];
+  for (int i = 0; i < dim; ++i) in[i] = 1.0f;
+  int64_t shape[2] = {1, dim};
+  float out[512];
+  int64_t out_shape[8];
+  int out_nd = 0;
+  if (pt_predictor_run(p, in, shape, 2, out, 512, out_shape, &out_nd)) {
+    fprintf(stderr, "run failed: %s\n", pt_last_error());
+    return 1;
+  }
+  int64_t n = 1;
+  for (int i = 0; i < out_nd; ++i) n *= out_shape[i];
+  printf("OUT");
+  for (int64_t i = 0; i < n; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  pt_predictor_destroy(p);
+  return 0;
+}

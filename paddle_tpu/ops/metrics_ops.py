@@ -4,6 +4,7 @@ streaming accumulation lives in paddle_tpu/metrics.py like the reference's
 python-side fluid.metrics.
 """
 
+import jax
 import jax.numpy as jnp
 
 from .common import I64
@@ -159,15 +160,50 @@ def _positive_negative_pair(ctx, op):
     # own tests don't pin down); here the three counts are disjoint.
     w = ctx.in1(op, "Weight")
     w = jnp.ones_like(score) if w is None else w.reshape(-1)
-    pair_w = (w[:, None] + w[None, :]) * 0.5
 
-    same_q = qid[:, None] == qid[None, :]
-    lab_gt = label[:, None] > label[None, :]
-    considered = same_q & lab_gt          # ordered pairs, counted once
-    sc_d = score[:, None] - score[None, :]
-    pos = jnp.sum(jnp.where(considered & (sc_d > 0), pair_w, 0.0))
-    neg = jnp.sum(jnp.where(considered & (sc_d < 0), pair_w, 0.0))
-    neu = jnp.sum(jnp.where(considered & (sc_d == 0), pair_w, 0.0))
+    n_rows = score.shape[0]
+
+    def counts(rows):
+        """pair counts for row block `rows` (indices) vs ALL rows —
+        bounds pairwise memory at [chunk, N] instead of [N, N]."""
+        s_i, l_i, q_i, w_i = (a[rows] for a in (score, label, qid, w))
+        pair_w = (w_i[:, None] + w[None, :]) * 0.5
+        considered = (q_i[:, None] == qid[None, :]) & \
+            (l_i[:, None] > label[None, :])
+        sc_d = s_i[:, None] - score[None, :]
+        return jnp.stack([
+            jnp.sum(jnp.where(considered & (sc_d > 0), pair_w, 0.0)),
+            jnp.sum(jnp.where(considered & (sc_d < 0), pair_w, 0.0)),
+            jnp.sum(jnp.where(considered & (sc_d == 0), pair_w, 0.0))])
+
+    chunk = 2048
+    if n_rows <= chunk:
+        pos, neg, neu = counts(jnp.arange(n_rows))
+    else:
+        pad = (-n_rows) % chunk
+        idx = jnp.arange(n_rows + pad).reshape(-1, chunk)
+        # pad rows point at row 0 with label compare against themselves —
+        # mask by validity instead: clip + zero weights for pad indices
+        valid = idx < n_rows
+        idx = jnp.clip(idx, 0, n_rows - 1)
+
+        def counts_masked(rows, ok):
+            s_i, l_i, q_i = (a[rows] for a in (score, label, qid))
+            w_i = jnp.where(ok, w[rows], 0.0)
+            pair_w = (w_i[:, None] + w[None, :]) * 0.5
+            considered = ok[:, None] & \
+                (q_i[:, None] == qid[None, :]) & \
+                (l_i[:, None] > label[None, :])
+            sc_d = s_i[:, None] - score[None, :]
+            return jnp.stack([
+                jnp.sum(jnp.where(considered & (sc_d > 0), pair_w, 0.0)),
+                jnp.sum(jnp.where(considered & (sc_d < 0), pair_w, 0.0)),
+                jnp.sum(jnp.where(considered & (sc_d == 0), pair_w, 0.0))])
+
+        total, _ = jax.lax.scan(
+            lambda acc, a: (acc + counts_masked(a[0], a[1]), None),
+            jnp.zeros(3), (idx, valid))
+        pos, neg, neu = total
 
     acc_p = ctx.in1(op, "AccumulatePositivePair", jnp.zeros((1,)))
     acc_n = ctx.in1(op, "AccumulateNegativePair", jnp.zeros((1,)))

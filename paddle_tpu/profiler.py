@@ -21,6 +21,8 @@ __all__ = ["profiler", "tpu_profiler", "cuda_profiler", "reset_profiler",
 
 _events = defaultdict(lambda: [0, 0.0])   # name -> [count, total_s]
 _trace = []                               # (name, start_s, dur_s, thread)
+_trace_dropped = 0                        # spans past the cap
+_TRACE_CAP = 1_000_000                    # bound host memory on long runs
 _enabled = False
 
 
@@ -41,15 +43,21 @@ class RecordEvent:
             ev = _events[self.name]
             ev[0] += 1
             ev[1] += now - self._t0
-            import threading
-            _trace.append((self.name, self._t0, now - self._t0,
-                           threading.get_ident()))
+            if len(_trace) < _TRACE_CAP:
+                import threading
+                _trace.append((self.name, self._t0, now - self._t0,
+                               threading.get_ident()))
+            else:
+                global _trace_dropped
+                _trace_dropped += 1
         return False
 
 
 def reset_profiler():
+    global _trace_dropped
     _events.clear()
     del _trace[:]
+    _trace_dropped = 0
 
 
 def export_chrome_trace(path):

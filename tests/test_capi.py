@@ -1,0 +1,60 @@
+"""C inference API (paddle_tpu/native/capi): a pure-C program loads a
+saved inference model and runs forward — the reference's
+paddle/capi/gradient_machine.h deployment capability (C ABI over an
+embedded CPython driving the same load_inference_model path)."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "native")
+
+
+@pytest.fixture(scope="module")
+def capi_bin():
+    try:
+        subprocess.run(["make", "-C", NATIVE, "build/libcapi.so",
+                        "build/test_capi"],
+                       check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        pytest.skip("C API build failed: %s" % e.stderr[-400:])
+    return os.path.join(NATIVE, "build", "test_capi")
+
+
+def test_c_program_runs_saved_model(tmp_path, capi_bin):
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.fc(x, 3, act="relu")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe)
+    want, = exe.run(feed={"x": np.ones((1, 4), np.float32)},
+                    fetch_list=[y])
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(NATIVE.rstrip("/")).rsplit(
+        "/paddle_tpu", 1)[0]
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([capi_bin, model_dir, "4"], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-500:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("OUT")][0]
+    got = np.array([float(v) for v in line.split()[1:]], np.float32)
+    np.testing.assert_allclose(got, np.asarray(want).reshape(-1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_c_program_reports_missing_model(capi_bin):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(NATIVE.rstrip("/")).rsplit(
+        "/paddle_tpu", 1)[0]
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([capi_bin, "/nonexistent/model", "4"], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode != 0
+    assert "failed" in out.stderr

@@ -926,3 +926,24 @@ def test_reorder_lod_tensor_by_rank_rowwise():
         out, = exe.run(prog, feed={"seq": seq, "x": x},
                        fetch_list=["out"])
     np.testing.assert_allclose(np.asarray(out), x[[1, 0]])
+
+
+def test_positive_negative_pair_chunked_matches_direct():
+    # >2048 rows exercises the chunked [chunk, N] path; counts must match
+    # the direct computation
+    rng = np.random.RandomState(7)
+    n = 2500
+    score = rng.rand(n, 1).astype(np.float32)
+    label = rng.randint(0, 3, (n, 1)).astype(np.float32)
+    qid = rng.randint(0, 50, (n, 1)).astype(np.int64)
+    got = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": qid}, {},
+                 ["PositivePair", "NegativePair", "NeutralPair"])
+    s, l, q = score.ravel(), label.ravel(), qid.ravel()
+    pos = neg = 0
+    for i in range(n):
+        same = (q == q[i]) & (l[i] > l)
+        pos += int(np.sum(same & (s[i] > s)))
+        neg += int(np.sum(same & (s[i] < s)))
+    assert float(np.asarray(got["PositivePair"])[0]) == pos
+    assert float(np.asarray(got["NegativePair"])[0]) == neg
