@@ -61,6 +61,7 @@ from .transpiler import (  # noqa: F401
     InferenceTranspiler, memory_optimize, release_memory,
 )
 from . import amp  # noqa: F401
+from . import flags  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed import DistributeTranspiler  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401

@@ -37,14 +37,12 @@ from .lod import LoDTensor
 _NANGUARD = "__nanguard__"
 
 
-def _flag_on(name, default=False):
-    """Env-flag parsing with gflags semantics: '0'/'false'/'off'/'no' mean
-    OFF regardless of case; unset/empty means `default` (a bare bool()
-    would read '0' as enabled)."""
-    raw = os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    return raw.strip().lower() not in ("0", "false", "off", "no")
+def _flag_on(name):
+    """Env-flag lookup through the central flag table (paddle_tpu.flags;
+    gflags semantics — '0'/'false'/'off'/'no' mean OFF). Flags must be
+    registered there; the table is the single source of parsing truth."""
+    from .. import flags
+    return bool(flags.get_flag(name.replace("PADDLE_TPU_", "").lower()))
 
 
 def _normalize_feeds(feed):
@@ -63,7 +61,7 @@ def _normalize_feeds(feed):
     per-sequence length that bounds scan depth in the RNN packers.
     """
     feed_arrays, feed_lods, static_info = {}, {}, {}
-    bucket_on = _flag_on("PADDLE_TPU_LOD_BUCKETING", default=True)
+    bucket_on = _flag_on("PADDLE_TPU_LOD_BUCKETING")
     for k, v in feed.items():
         if isinstance(v, LoDTensor):
             arr = v.data

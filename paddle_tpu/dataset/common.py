@@ -4,7 +4,7 @@ Reference parity: python/paddle/dataset/common.py (cached download + reader
 conventions). This environment has no network egress, so every dataset module
 provides a deterministic *synthetic* generator with the same reader API,
 shapes, and vocabulary sizes as the real dataset; if the real files are
-already present under DATA_HOME they are used instead.
+already present under _data_home() they are used instead.
 """
 
 import hashlib
@@ -12,12 +12,15 @@ import os
 
 import numpy as np
 
-DATA_HOME = os.path.expanduser(os.environ.get(
-    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+def _data_home():
+    # resolved per call through the central flag table so
+    # flags.set_flag("data_home", ...) and late env changes are honored
+    from .. import flags
+    return os.path.expanduser(flags.get_flag("data_home"))
 
 
 def data_path(module, filename):
-    return os.path.join(DATA_HOME, module, filename)
+    return os.path.join(_data_home(), module, filename)
 
 
 def have_file(module, filename):

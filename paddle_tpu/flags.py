@@ -1,0 +1,97 @@
+"""Flag / env bootstrap layer.
+
+Reference parity: the gflags system (utils/Flags.h; fluid's
+``__bootstrap__`` in python/paddle/fluid/__init__.py reads selected
+FLAGS_* env vars at import). Here every runtime flag is registered in one
+table with type, default, and docs; values come from ``PADDLE_TPU_*``
+environment variables (gflags semantics for booleans: 0/false/off/no =
+off) and can be read or overridden programmatically via get_flag/set_flag.
+
+Registered flags:
+  check_nan_inf   bool  per-op NaN/Inf guards in the compiled step
+                        (FLAGS_check_nan_inf parity, executor.cc:27-94)
+  lod_bucketing   bool  bucket flat LoD totals to powers of two so text
+                        batches share compiled steps (SURVEY §7)
+  debug_nans      bool  jax_debug_nans — XLA-level NaN tracer (heavier
+                        than check_nan_inf; locates the primitive)
+  data_home       str   dataset cache directory
+
+Distributed bootstrap envs (read by distributed.launch, not here):
+  PADDLE_COORDINATOR, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID.
+"""
+
+import os
+
+_TRUTHY_OFF = ("0", "false", "off", "no")
+
+
+class _Flag:
+    def __init__(self, name, type_, default, help_):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+        self.env = "PADDLE_TPU_" + name.upper()
+        self._override = None
+
+    def value(self):
+        if self._override is not None:
+            return self._override
+        raw = os.environ.get(self.env)
+        if raw is None or not raw.strip():
+            return self.default
+        raw = raw.strip()
+        if self.type is bool:
+            return raw.lower() not in _TRUTHY_OFF
+        return self.type(raw)
+
+
+_FLAGS = {}
+
+
+def _register(name, type_, default, help_):
+    _FLAGS[name] = _Flag(name, type_, default, help_)
+
+
+_register("check_nan_inf", bool, False,
+          "scan every op output for NaN/Inf inside the compiled step")
+_register("lod_bucketing", bool, True,
+          "bucket flat LoD feed totals to the next power of two")
+_register("debug_nans", bool, False,
+          "enable jax_debug_nans (XLA-level NaN localization)")
+_register("data_home", str,
+          os.path.expanduser("~/.cache/paddle_tpu/dataset"),
+          "dataset cache directory")
+
+
+def get_flag(name):
+    return _FLAGS[name].value()
+
+
+def set_flag(name, value):
+    """Programmatic override (wins over the environment)."""
+    _FLAGS[name]._override = value
+    if name == "debug_nans":
+        _apply_debug_nans()
+
+
+def flags_help():
+    return "\n".join(
+        "%-16s %-5s default=%r env=%s\n    %s"
+        % (f.name, f.type.__name__, f.default, f.env, f.help)
+        for f in _FLAGS.values())
+
+
+def _apply_debug_nans():
+    import jax
+    jax.config.update("jax_debug_nans", bool(get_flag("debug_nans")))
+
+
+def __bootstrap__():
+    """Read env-driven flags that must take effect at import (the
+    reference's __bootstrap__ shape)."""
+    if get_flag("debug_nans"):
+        _apply_debug_nans()
+
+
+__bootstrap__()

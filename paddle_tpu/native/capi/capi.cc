@@ -13,6 +13,8 @@
 //                          const float* in, const int64_t* shape, int nd,
 //                          float* out, int64_t out_cap,
 //                          int64_t* out_shape, int* out_nd);
+//       out_shape must have at least 8 slots (max supported rank);
+//       higher-rank fetches fail with an error instead of truncating.
 //   void  pt_predictor_destroy(void* p);
 //   const char* pt_last_error();
 //
@@ -34,7 +36,9 @@ void set_error_from_python() {
   PyErr_Fetch(&type, &value, &tb);
   PyErr_NormalizeException(&type, &value, &tb);
   PyObject* s = value ? PyObject_Str(value) : nullptr;
-  g_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  const char* msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+  g_error = msg ? msg : "unknown python error";
+  PyErr_Clear();  // AsUTF8 may raise; never leave an exception pending
   Py_XDECREF(s);
   Py_XDECREF(type);
   Py_XDECREF(value);
@@ -163,9 +167,11 @@ int pt_predictor_run(void* handle, const float* in, const int64_t* shape,
     int ond = int(PyList_Size(oshp));
     if (out_n > out_cap) {
       g_error = "output buffer too small";
+    } else if (ond > 8) {
+      g_error = "output rank exceeds the 8-slot out_shape contract";
     } else {
       memcpy(out, data, size_t(nbytes));
-      for (int i = 0; i < ond && i < 8; ++i) {
+      for (int i = 0; i < ond; ++i) {
         out_shape[i] = PyLong_AsLongLong(PyList_GetItem(oshp, i));
       }
       *out_nd = ond;

@@ -163,12 +163,13 @@ def _positive_negative_pair(ctx, op):
 
     n_rows = score.shape[0]
 
-    def counts(rows):
-        """pair counts for row block `rows` (indices) vs ALL rows —
-        bounds pairwise memory at [chunk, N] instead of [N, N]."""
-        s_i, l_i, q_i, w_i = (a[rows] for a in (score, label, qid, w))
-        pair_w = (w_i[:, None] + w[None, :]) * 0.5
-        considered = (q_i[:, None] == qid[None, :]) & \
+    def counts(rows, ok):
+        """pair counts for row block `rows` (indices, validity `ok`) vs
+        ALL rows — bounds pairwise memory at [chunk, N], not [N, N]."""
+        s_i, l_i, q_i = (a[rows] for a in (score, label, qid))
+        pair_w = (w[rows][:, None] + w[None, :]) * 0.5
+        considered = ok[:, None] & \
+            (q_i[:, None] == qid[None, :]) & \
             (l_i[:, None] > label[None, :])
         sc_d = s_i[:, None] - score[None, :]
         return jnp.stack([
@@ -178,30 +179,15 @@ def _positive_negative_pair(ctx, op):
 
     chunk = 2048
     if n_rows <= chunk:
-        pos, neg, neu = counts(jnp.arange(n_rows))
+        pos, neg, neu = counts(jnp.arange(n_rows),
+                               jnp.ones((n_rows,), bool))
     else:
         pad = (-n_rows) % chunk
         idx = jnp.arange(n_rows + pad).reshape(-1, chunk)
-        # pad rows point at row 0 with label compare against themselves —
-        # mask by validity instead: clip + zero weights for pad indices
         valid = idx < n_rows
         idx = jnp.clip(idx, 0, n_rows - 1)
-
-        def counts_masked(rows, ok):
-            s_i, l_i, q_i = (a[rows] for a in (score, label, qid))
-            w_i = jnp.where(ok, w[rows], 0.0)
-            pair_w = (w_i[:, None] + w[None, :]) * 0.5
-            considered = ok[:, None] & \
-                (q_i[:, None] == qid[None, :]) & \
-                (l_i[:, None] > label[None, :])
-            sc_d = s_i[:, None] - score[None, :]
-            return jnp.stack([
-                jnp.sum(jnp.where(considered & (sc_d > 0), pair_w, 0.0)),
-                jnp.sum(jnp.where(considered & (sc_d < 0), pair_w, 0.0)),
-                jnp.sum(jnp.where(considered & (sc_d == 0), pair_w, 0.0))])
-
         total, _ = jax.lax.scan(
-            lambda acc, a: (acc + counts_masked(a[0], a[1]), None),
+            lambda acc, a: (acc + counts(a[0], a[1]), None),
             jnp.zeros(3), (idx, valid))
         pos, neg, neu = total
 

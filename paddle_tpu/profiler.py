@@ -70,6 +70,13 @@ def export_chrome_trace(path):
                "ts": start * 1e6, "dur": dur * 1e6,
                "cat": "host"}
               for name, start, dur, tid in _trace]
+    if _trace_dropped:
+        # surface the cap: a truncated timeline must say so in-band
+        events.append({"name": "TRACE TRUNCATED: %d spans dropped past "
+                               "the %d cap" % (_trace_dropped, _TRACE_CAP),
+                       "ph": "i", "pid": 0, "tid": 0,
+                       "ts": (_trace[-1][1] + _trace[-1][2]) * 1e6
+                       if _trace else 0, "s": "g", "cat": "host"})
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)

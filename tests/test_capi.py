@@ -21,8 +21,9 @@ def capi_bin():
         subprocess.run(["make", "-C", NATIVE, "build/libcapi.so",
                         "build/test_capi"],
                        check=True, capture_output=True, text=True)
-    except subprocess.CalledProcessError as e:
-        pytest.skip("C API build failed: %s" % e.stderr[-400:])
+    except (OSError, subprocess.CalledProcessError) as e:
+        pytest.skip("C API build failed: %s"
+                    % (getattr(e, "stderr", "") or str(e))[-400:])
     return os.path.join(NATIVE, "build", "test_capi")
 
 

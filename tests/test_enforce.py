@@ -98,3 +98,17 @@ def test_nan_guard_honored_by_parallel_executor(monkeypatch):
     with pytest.raises(FloatingPointError):
         pexe.run([good], feed={"x": -np.ones((4, 4), np.float32)})
     assert bad is not None
+
+
+def test_flags_table(monkeypatch):
+    from paddle_tpu import flags
+    assert flags.get_flag("lod_bucketing") is True
+    monkeypatch.setenv("PADDLE_TPU_LOD_BUCKETING", "off")
+    assert flags.get_flag("lod_bucketing") is False
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NAN_INF", "1")
+    assert flags.get_flag("check_nan_inf") is True
+    assert "check_nan_inf" in flags.flags_help()
+    flags.set_flag("check_nan_inf", False)
+    assert flags.get_flag("check_nan_inf") is False
+    flags.set_flag("check_nan_inf", None)   # drop override
+    assert flags.get_flag("check_nan_inf") is True
