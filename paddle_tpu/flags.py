@@ -69,8 +69,16 @@ def get_flag(name):
 
 
 def set_flag(name, value):
-    """Programmatic override (wins over the environment)."""
-    _FLAGS[name]._override = value
+    """Programmatic override (wins over the environment). Values coerce
+    through the flag's type with the same gflags parsing env vars get, so
+    set_flag('lod_bucketing', 'off') really turns it off."""
+    f = _FLAGS[name]
+    if value is not None and not isinstance(value, f.type):
+        if f.type is bool:
+            value = str(value).strip().lower() not in _TRUTHY_OFF
+        else:
+            value = f.type(value)
+    f._override = value
     if name == "debug_nans":
         _apply_debug_nans()
 

@@ -1,0 +1,70 @@
+"""DeepFM CTR model — the sparse-embedding workload SURVEY §7 M5 names
+(the reference serves it with the distributed lookup table:
+distribute_transpiler.py:201-255, lookup_table_op.cc `is_distributed`).
+
+Architecture (DeepFM): per-field sparse id embeddings feed BOTH a
+factorization machine (first-order weights + pairwise second-order
+interactions via the sum-square/square-sum identity) and a DNN over the
+concatenated embeddings; logits add. Sparse gradients flow through the
+lookup_table `is_sparse` path, and under the DistributeTranspiler the
+same table splits across pservers with prefetch.
+"""
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def deepfm(field_inputs, vocab_size, embed_dim=8, dnn_dims=(32, 32),
+           is_sparse=True, is_distributed=False):
+    """field_inputs: list of [B, 1] int64 Variables (one id per field).
+    Returns (prob [B, 1], logit [B, 1])."""
+    num_fields = len(field_inputs)
+
+    # first-order term: a 1-wide embedding per id
+    first = [layers.embedding(
+        x, size=[vocab_size, 1], is_sparse=is_sparse,
+        is_distributed=is_distributed,
+        param_attr=fluid.ParamAttr(name="fm_first_w"))
+        for x in field_inputs]
+    y_first = layers.sums([layers.reshape(f, [-1, 1]) for f in first])
+
+    # second-order term over shared k-dim embeddings:
+    # 0.5 * sum_k[(sum_f v_fk)^2 - sum_f v_fk^2]
+    embeds = [layers.embedding(
+        x, size=[vocab_size, embed_dim], is_sparse=is_sparse,
+        is_distributed=is_distributed,
+        param_attr=fluid.ParamAttr(name="fm_second_w"))
+        for x in field_inputs]
+    embeds2d = [layers.reshape(e, [-1, embed_dim]) for e in embeds]
+    sum_v = layers.sums(embeds2d)
+    sum_sq = fluid.layers.elementwise_mul(sum_v, sum_v)
+    sq_sum = layers.sums(
+        [fluid.layers.elementwise_mul(e, e) for e in embeds2d])
+    second = fluid.layers.scale(
+        fluid.layers.elementwise_sub(sum_sq, sq_sum), scale=0.5)
+    y_second = fluid.layers.reduce_sum(second, dim=[1], keep_dim=True)
+
+    # deep component over the concatenated field embeddings
+    deep = layers.concat(embeds2d, axis=1)      # [B, F*k]
+    for width in dnn_dims:
+        deep = layers.fc(deep, width, act="relu")
+    y_deep = layers.fc(deep, 1)
+
+    logit = fluid.layers.elementwise_add(
+        fluid.layers.elementwise_add(y_first, y_second), y_deep)
+    prob = fluid.layers.sigmoid(logit)
+    return prob, logit
+
+
+def build_train_net(num_fields=8, vocab_size=1000, embed_dim=8,
+                    learning_rate=1e-2, is_sparse=True):
+    """CTR training net: per-field ids + 0/1 click label -> log loss."""
+    fields = [layers.data("field_%d" % i, [1], dtype="int64")
+              for i in range(num_fields)]
+    label = layers.data("click", [1])
+    prob, logit = deepfm(fields, vocab_size, embed_dim,
+                         is_sparse=is_sparse)
+    loss = fluid.layers.mean(
+        fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+    fluid.optimizer.Adam(learning_rate=learning_rate).minimize(loss)
+    return fields, label, prob, loss

@@ -1,0 +1,85 @@
+"""DeepFM CTR model (models/deepfm.py) — the sparse-embedding workload of
+SURVEY M5: trains through the lookup_table is_sparse path, learns a
+synthetic click rule, and its FM second-order term matches the explicit
+pairwise-interaction computation."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import deepfm
+
+
+def _batch(rng, batch, num_fields, vocab):
+    ids = rng.randint(0, vocab, (batch, num_fields)).astype(np.int64)
+    # click iff field0 id is even AND field1 id < vocab/2 (learnable from
+    # the embeddings alone)
+    click = ((ids[:, 0] % 2 == 0) & (ids[:, 1] < vocab // 2))
+    return ids, click.astype(np.float32).reshape(-1, 1)
+
+
+def test_deepfm_learns_synthetic_ctr():
+    num_fields, vocab = 6, 64
+    fields, label, prob, loss = deepfm.build_train_net(
+        num_fields=num_fields, vocab_size=vocab, embed_dim=8,
+        learning_rate=2e-2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    first = last = None
+    for _ in range(150):
+        ids, click = _batch(rng, 64, num_fields, vocab)
+        feed = {f.name: ids[:, i:i + 1] for i, f in enumerate(fields)}
+        feed["click"] = click
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    # below ln2 (chance) and well below the start
+    assert last < 0.45, (first, last)
+    assert last < first * 0.7, (first, last)
+
+    # predicted probabilities separate clicks from non-clicks
+    ids, click = _batch(rng, 256, num_fields, vocab)
+    feed = {f.name: ids[:, i:i + 1] for i, f in enumerate(fields)}
+    feed["click"] = click
+    p, = exe.run(feed=feed, fetch_list=[prob])
+    p = np.asarray(p).ravel()
+    assert p[click.ravel() > 0].mean() > p[click.ravel() == 0].mean() + 0.2
+
+
+def test_fm_second_order_identity():
+    # the sum-square/square-sum trick == explicit pairwise dot products
+    num_fields, vocab, k = 4, 20, 5
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        fields = [fluid.layers.data("f%d" % i, [1], dtype="int64")
+                  for i in range(num_fields)]
+        _, logit = deepfm.deepfm(fields, vocab, embed_dim=k,
+                                 dnn_dims=(4,))
+        # the model's second-order term is the (only) reduce_sum output
+        second_name = [op.outputs["Out"][0]
+                       for op in prog.global_block().ops
+                       if op.type == "reduce_sum"][0]
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            rng = np.random.RandomState(1)
+            ids = rng.randint(0, vocab, (3, num_fields)).astype(np.int64)
+            feed = {f.name: ids[:, i:i + 1]
+                    for i, f in enumerate(fields)}
+            out, second = exe.run(prog, feed=feed,
+                                  fetch_list=[logit, second_name])
+            v = np.asarray(scope.find_var("fm_second_w"))
+
+    # the FRAMEWORK's fetched second-order term must equal the explicit
+    # numpy pairwise-interaction sum
+    emb = v[ids]                                     # [B, F, k]
+    pairwise = np.zeros(3)
+    for b in range(3):
+        for i in range(num_fields):
+            for j in range(i + 1, num_fields):
+                pairwise[b] += emb[b, i] @ emb[b, j]
+    np.testing.assert_allclose(np.asarray(second).ravel(), pairwise,
+                               rtol=1e-4, atol=1e-5)
+    assert np.asarray(out).shape == (3, 1)
