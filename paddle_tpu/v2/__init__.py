@@ -29,6 +29,7 @@ from . import inference  # noqa: F401
 from . import layer  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import parameters as _parameters_mod
+from . import plot  # noqa: F401
 from . import trainer  # noqa: F401
 from .inference import infer  # noqa: F401
 

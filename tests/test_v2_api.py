@@ -130,3 +130,20 @@ def test_v2_type_errors():
         paddle.trainer.SGD(cost, parameters, "not-an-optimizer")
     with pytest.raises(TypeError):
         paddle.layer.data("x", [8])   # fluid-style shape is not a v2 type
+
+
+def test_v2_ploter(tmp_path):
+    from paddle_tpu.v2.plot import Ploter
+    p = Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+    p.append("test", 0, 0.9)
+    out = str(tmp_path / "curve.png")
+    p.plot(out)
+    import os
+    assert os.path.exists(out) or os.path.exists(out + ".csv")
+    p.save_csv(str(tmp_path / "c.csv"))
+    lines = (tmp_path / "c.csv").read_text().strip().splitlines()
+    assert len(lines) == 6
+    p.reset()
+    assert p.data["train"] == ([], [])
