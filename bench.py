@@ -32,6 +32,9 @@ def _run(argv):
 def main():
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "benchmarks"))
+    # best-of-3 timing windows: the sandbox tunnel's variance must not be
+    # recorded as the chip's number (PERF.md "Measurement variance")
+    os.environ.setdefault("PADDLE_TPU_BENCH_WINDOWS", "3")
 
     _run(["--batch_size", "256", "--iterations", "20",
           "--skip_batch_num", "3", "--device", "TPU",
