@@ -16,12 +16,13 @@ def main():
             p.add_argument("--max_len", type=int, default=64),
             p.add_argument("--n_layer", type=int, default=2),
             p.add_argument("--d_model", type=int, default=256),
-            p.add_argument("--dict_size", type=int, default=8192)))
+            p.add_argument("--dict_size", type=int, default=8192),
+            p.add_argument("--packed", type=int, default=0)))
     avg_cost, _ = T.transformer(
         src_vocab_size=args.dict_size, trg_vocab_size=args.dict_size,
         max_len=args.max_len, n_layer=args.n_layer, n_head=8,
         d_model=args.d_model, d_inner=4 * args.d_model,
-        label_smooth_eps=0.1)
+        label_smooth_eps=0.1, packed=bool(args.packed))
     fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     exe = fluid.Executor(get_place(args))
     exe.run(fluid.default_startup_program())

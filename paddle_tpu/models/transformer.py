@@ -214,10 +214,14 @@ def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
 
 def transformer(src_vocab_size=4096, trg_vocab_size=4096, max_len=64,
                 n_layer=2, n_head=8, d_model=256, d_inner=1024,
-                dropout_rate=0.0, label_smooth_eps=0.0):
+                dropout_rate=0.0, label_smooth_eps=0.0, packed=False):
     """Encoder-decoder MT model (machine_translation benchmark parity).
     Feeds: src_word, src_pos, src_mask, trg_word, trg_pos, trg_mask,
-    lbl_word — all [B, T]. Returns (avg_cost, predictions)."""
+    lbl_word — all [B, T]. Returns (avg_cost, predictions).
+
+    packed=True assumes full-length (packed) sequences: padding biases are
+    dropped and decoder self-attention takes the fused flash path
+    (causal in-kernel); `trg_mask` still weights the loss."""
     d_key = d_value = d_model // n_head
     src_word = layers.data("src_word", [max_len], dtype="int64")
     src_pos = layers.data("src_pos", [max_len], dtype="int64")
@@ -229,7 +233,7 @@ def transformer(src_vocab_size=4096, trg_vocab_size=4096, max_len=64,
 
     enc_in = _embed(src_word, src_vocab_size, d_model, max_len, src_pos,
                     "src")
-    enc_bias = make_attn_bias(src_mask, n_head)
+    enc_bias = None if packed else make_attn_bias(src_mask, n_head)
     enc = enc_in
     for _ in range(n_layer):
         enc = encoder_layer(enc, enc_bias, n_head, d_key, d_value, d_model,
@@ -237,18 +241,24 @@ def transformer(src_vocab_size=4096, trg_vocab_size=4096, max_len=64,
 
     dec_in = _embed(trg_word, trg_vocab_size, d_model, max_len, trg_pos,
                     "trg")
-    slf_bias = make_attn_bias(trg_mask, n_head, causal=True)
-    # cross bias: queries = trg positions, keys = src positions
-    b = src_mask.shape[0]
-    t = max_len
-    key_mask = layers.reshape(src_mask, [b, 1, 1, t])
-    cross_bias = layers.scale(key_mask, 1e9, bias=-1.0,
-                              bias_after_scale=False)
-    cross_bias = layers.expand(cross_bias, expand_times=[1, n_head, t, 1])
+    slf_bias = None if packed else make_attn_bias(trg_mask, n_head,
+                                                  causal=True)
+    if packed:
+        cross_bias = None
+    else:
+        # cross bias: queries = trg positions, keys = src positions
+        b = src_mask.shape[0]
+        t = max_len
+        key_mask = layers.reshape(src_mask, [b, 1, 1, t])
+        cross_bias = layers.scale(key_mask, 1e9, bias=-1.0,
+                                  bias_after_scale=False)
+        cross_bias = layers.expand(cross_bias,
+                                   expand_times=[1, n_head, t, 1])
     dec = dec_in
     for _ in range(n_layer):
         dec = decoder_layer(dec, enc, slf_bias, cross_bias, n_head, d_key,
-                            d_value, d_model, d_inner, dropout_rate)
+                            d_value, d_model, d_inner, dropout_rate,
+                            causal=packed)
 
     logits = layers.fc(dec, trg_vocab_size, num_flatten_dims=2,
                        bias_attr=False)

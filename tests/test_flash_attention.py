@@ -125,3 +125,50 @@ def test_composed_fallback_keeps_causal_mask():
             o2, = exe.run(prog, feed={"x": x2}, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(o1)[:, :-1], np.asarray(o2)[:, :-1],
                                atol=1e-4)
+
+
+def test_packed_encdec_transformer_matches_masked():
+    # packed=True (fused causal self-attn, no bias constants) must equal
+    # packed=False under all-ones masks — same math, different route
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+
+    def build(packed, seed=11):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            prog.random_seed = seed
+            cost, _ = T.transformer(
+                src_vocab_size=32, trg_vocab_size=32, max_len=8,
+                n_layer=1, n_head=2, d_model=16, d_inner=32,
+                packed=packed)
+            scope = fluid.Scope()
+            exe = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+            return prog, cost, scope, exe
+
+    p1, c1, s1, e1 = build(False)
+    p2, c2, s2, e2 = build(True)
+    # identical params
+    for v in p1.global_block().all_parameters():
+        s2.set(v.name, np.array(np.asarray(s1.find_var(v.name))))
+
+    rng = np.random.RandomState(0)
+    b, t = 2, 8
+    pos = np.tile(np.arange(t, dtype=np.int64), (b, 1))
+    ones = np.ones((b, t), np.float32)
+    feeds = {"src_word": rng.randint(3, 32, (b, t)).astype(np.int64),
+             "src_pos": pos, "src_mask": ones,
+             "trg_word": rng.randint(3, 32, (b, t)).astype(np.int64),
+             "trg_pos": pos, "trg_mask": ones,
+             "lbl_word": rng.randint(3, 32, (b, t)).astype(np.int64)}
+    with fluid.scope_guard(s1):
+        l1, = e1.run(p1, feed=feeds, fetch_list=[c1])
+    with fluid.scope_guard(s2):
+        l2, = e2.run(p2, feed=feeds, fetch_list=[c2])
+    np.testing.assert_allclose(float(np.asarray(l1)),
+                               float(np.asarray(l2)), rtol=1e-5)
+    # and sp_attention really is in the packed program
+    assert "sp_attention" in [op.type for op in p2.global_block().ops]
+    assert "sp_attention" not in [op.type
+                                  for op in p1.global_block().ops]
