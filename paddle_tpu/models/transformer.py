@@ -243,17 +243,9 @@ def transformer(src_vocab_size=4096, trg_vocab_size=4096, max_len=64,
                     "trg")
     slf_bias = None if packed else make_attn_bias(trg_mask, n_head,
                                                   causal=True)
-    if packed:
-        cross_bias = None
-    else:
-        # cross bias: queries = trg positions, keys = src positions
-        b = src_mask.shape[0]
-        t = max_len
-        key_mask = layers.reshape(src_mask, [b, 1, 1, t])
-        cross_bias = layers.scale(key_mask, 1e9, bias=-1.0,
-                                  bias_after_scale=False)
-        cross_bias = layers.expand(cross_bias,
-                                   expand_times=[1, n_head, t, 1])
+    # cross bias: queries = trg positions, keys = src positions (Tq == Tk
+    # == max_len, so the plain key-padding bias applies verbatim)
+    cross_bias = None if packed else make_attn_bias(src_mask, n_head)
     dec = dec_in
     for _ in range(n_layer):
         dec = decoder_layer(dec, enc, slf_bias, cross_bias, n_head, d_key,

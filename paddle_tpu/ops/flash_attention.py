@@ -37,15 +37,24 @@ DEFAULT_BLOCK_K = 256
 
 
 def _dense(q, k, v, causal, scale):
+    return _dense_lse(q, k, v, causal, scale)[0]
+
+
+def _dense_lse(q, k, v, causal, scale):
+    """Dense math returning (out, lse) — lse[b,h,i] = logsumexp_j s_ij.
+    The math-identical fallback for flash_attention_lse."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         t = s.shape[-1]
         mask = jnp.tril(jnp.ones((t, t), bool))
         s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(p.dtype)).astype(q.dtype)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p / l,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    return out, (m + jnp.log(l))[..., 0]
 
 
 # --------------------------------------------------------------------------
@@ -204,7 +213,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(res, dy, causal, scale, block_q, block_k, interpret):
+def _bwd_pallas(res, dy, causal, scale, block_q, block_k, interpret,
+                dlse=None):
     q, k, v, o, lse = res
     b, h, t, d = q.shape
     bh = b * h
@@ -213,6 +223,10 @@ def _bwd_pallas(res, dy, causal, scale, block_q, block_k, interpret):
     nq, nk = t // bq, t // bk
     delta = jnp.sum(dy.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                  # [B,H,T]
+    if dlse is not None:
+        # lse output cotangent: d lse_i / d s_ij = p_ij, so it folds into
+        # the shared ds = p * (dp - delta') term with delta' = delta - dlse
+        delta = delta - dlse.astype(jnp.float32)
     q3, k3, v3 = (a.reshape(bh, t, d) for a in (q, k, v))
     dy3 = dy.reshape(bh, t, d)
     lse3 = jnp.broadcast_to(lse.reshape(bh, t)[:, :, None],
@@ -288,11 +302,49 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, dy):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# --------------------------------------------------------------------------
+# (out, lse) variant: same kernels, but the log-sum-exp rows are a public,
+# differentiable output. Ring attention combines per-shard partial results
+# with these (parallel/ring.py), so d(loss)/d(lse) is generally non-zero.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, dys):
+    dy, dlse = dys
+    return _bwd_pallas(res, dy, causal, scale, block_q, block_k, interpret,
+                       dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def _on_tpu(x):
     try:
         return list(x.devices())[0].platform == "tpu"
     except Exception:
         return jax.default_backend() == "tpu"
+
+
+def _resolve_path(q, scale, block_q, block_k, force):
+    """Shared dispatch: (path, scale, bq, bk). path: "pallas" /
+    "interpret" / "dense" — auto picks the kernel on TPU when T divides
+    the blocks and the head dim tiles onto the lanes."""
+    scale = float(scale) if scale else q.shape[-1] ** -0.5
+    t = q.shape[2]
+    path = force
+    if path is None:
+        usable = (t % min(block_q, t) == 0 and t % min(block_k, t) == 0
+                  and t >= 128 and q.shape[-1] % 8 == 0)
+        path = "pallas" if (usable and _on_tpu(q)) else "dense"
+    return path, scale, min(block_q, t), min(block_k, t)
 
 
 def flash_attention(q, k, v, causal=False, scale=None,
@@ -304,18 +356,24 @@ def flash_attention(q, k, v, causal=False, scale=None,
     dense XLA math otherwise), "pallas" / "interpret" / "dense" pin a path
     (tests use "interpret" to run the kernel on CPU).
     """
-    scale = float(scale) if scale else q.shape[-1] ** -0.5
-    t = q.shape[2]
-    path = force
-    if path is None:
-        usable = (t % min(block_q, t) == 0 and t % min(block_k, t) == 0
-                  and t >= 128 and q.shape[-1] % 8 == 0)
-        path = "pallas" if (usable and _on_tpu(q)) else "dense"
+    path, scale, bq, bk = _resolve_path(q, scale, block_q, block_k, force)
     if path == "dense":
         return _dense(q, k, v, causal, scale)
-    interpret = path == "interpret"
-    return _flash(q, k, v, causal, scale, min(block_q, t), min(block_k, t),
-                  interpret)
+    return _flash(q, k, v, causal, scale, bq, bk, path == "interpret")
+
+
+def flash_attention_lse(q, k, v, causal=False, scale=None,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                        force=None):
+    """Like flash_attention but returns (out, lse) with
+    lse[b,h,i] = logsumexp_j(q_i·k_j*scale [+mask]) — the statistic ring
+    attention needs to merge partial attention over K/V shards. Both
+    outputs are differentiable (the lse cotangent folds into the shared
+    backward kernels)."""
+    path, scale, bq, bk = _resolve_path(q, scale, block_q, block_k, force)
+    if path == "dense":
+        return _dense_lse(q, k, v, causal, scale)
+    return _flash_lse(q, k, v, causal, scale, bq, bk, path == "interpret")
 
 
 # pallas imports placed at the end so a CPU-only environment that never

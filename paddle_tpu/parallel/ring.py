@@ -3,11 +3,20 @@
 Long-context design (task requirement; beyond the 2018 reference, which
 handled long sequences only by LoD batching — SURVEY.md §5.7): the sequence
 axis is sharded across devices; each device holds a Q shard and passes its
-K/V shard around the ring with ``ppermute`` while accumulating
-flash-attention-style streaming softmax statistics (running max + running
-denominator), so the full [T, T] score matrix never materializes and K/V
+K/V shard around the ring with ``ppermute`` while merging
+flash-attention-style partial results (per-shard output + log-sum-exp
+rows), so the full [T, T] score matrix never materializes and K/V
 transfers overlap with the blockwise matmuls (Liu et al., Ring Attention
 with Blockwise Transformers).
+
+Each ring step computes attention of the local Q shard against the
+currently-held K/V shard with the fused Pallas flash kernel
+(ops/flash_attention.flash_attention_lse — dense math off-TPU), then
+merges (out_i, lse_i) into the running accumulator by stable
+log-sum-exp weighting. Because shards are contiguous sequence chunks,
+the causal mask per step collapses to three cases: the diagonal shard is
+plain causal attention, earlier shards are unmasked, later shards
+contribute nothing.
 """
 
 import functools
@@ -18,59 +27,62 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-
-def _blockwise_attn_update(q, k, v, m_prev, l_prev, o_prev, scale,
-                           mask_value=-1e30, block_mask=None):
-    """One streaming-softmax accumulation step.
-    q [B,H,Tq,D], k/v [B,H,Tk,D]; m,l running max/denominator [B,H,Tq]."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if block_mask is not None:
-        s = jnp.where(block_mask, s, mask_value)
-    m_cur = jnp.max(s, axis=-1)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new[..., None])
-    correction = jnp.exp(m_prev - m_new)
-    l_new = l_prev * correction + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    o_new = o_prev * correction[..., None] + pv
-    return m_new, l_new, o_new
+_NEG_BIG = -1e30   # finite "-inf": keeps exp()==0 without inf-inf NaNs
 
 
 def _ring_attention_sharded(q, k, v, axis_name, causal, scale):
     """Per-shard body (inside shard_map). q/k/v: [B, H, T_local, D]."""
+    from ..ops.flash_attention import flash_attention_lse
+
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, t_local, d = q.shape
 
-    m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, t_local), jnp.float32)
-    o = jnp.zeros((b, h, t_local, d), jnp.float32)
+    def diag_block(k_cur, v_cur):      # src == me: aligned causal mask
+        return flash_attention_lse(q, k_cur, v_cur, causal=causal,
+                                   scale=scale)
+
+    def full_block(k_cur, v_cur):      # src strictly before me: no mask
+        return flash_attention_lse(q, k_cur, v_cur, causal=False,
+                                   scale=scale)
+
+    def skip_block(k_cur, v_cur):      # src after me: fully masked out
+        return (jnp.zeros(q.shape, q.dtype),
+                jnp.full((b, h, t_local), _NEG_BIG, jnp.float32))
+
+    # Deferred-normalization carry (one divide AFTER the loop, not per
+    # step): num = Σ_seen o_j·e^{lse_j - m_run}, s = Σ_seen e^{lse_j -
+    # m_run}, with m_run the running max of the seen shards' lse rows.
+    num = jnp.zeros((b, h, t_local, d), jnp.float32)
+    s = jnp.zeros((b, h, t_local), jnp.float32)
+    m_run = jnp.full((b, h, t_local), _NEG_BIG, jnp.float32)
 
     def ring_step(i, carry):
-        m, l, o, k_cur, v_cur = carry
-        src_idx = (my_idx - i) % axis_size   # whose K/V block we hold now
+        num, s, m_run, k_cur, v_cur = carry
+        src_idx = (my_idx - i) % axis_size   # whose K/V shard we hold now
         if causal:
-            # global positions: q_pos = my_idx*T + tq, k_pos = src*T + tk
-            q_pos = my_idx * t_local + jnp.arange(t_local)
-            k_pos = src_idx * t_local + jnp.arange(t_local)
-            block_mask = q_pos[:, None] >= k_pos[None, :]
-            block_mask = jnp.broadcast_to(block_mask,
-                                          (b, h, t_local, t_local))
+            case = jnp.where(src_idx == my_idx, 0,
+                             jnp.where(src_idx < my_idx, 1, 2))
+            o_i, lse_i = lax.switch(case, (diag_block, full_block,
+                                           skip_block), k_cur, v_cur)
         else:
-            block_mask = None
-        m, l, o = _blockwise_attn_update(q, k_cur, v_cur, m, l, o, scale,
-                                         block_mask=block_mask)
-        # rotate K/V shards around the ring (overlaps with next matmul
-        # after XLA latency-hiding scheduling)
+            o_i, lse_i = full_block(k_cur, v_cur)
+        m_new = jnp.maximum(m_run, lse_i)
+        alpha = jnp.exp(m_run - m_new)       # rescales the old partials
+        w_i = jnp.exp(lse_i - m_new)         # this shard's weight
+        num = num * alpha[..., None] \
+            + o_i.astype(jnp.float32) * w_i[..., None]
+        s = s * alpha + w_i
+        # rotate K/V shards around the ring (overlaps with the next
+        # step's matmuls after XLA latency-hiding scheduling)
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return m, l, o, k_nxt, v_nxt
+        return num, s, m_new, k_nxt, v_nxt
 
-    m, l, o, _, _ = lax.fori_loop(0, axis_size, ring_step, (m, l, o, k, v))
-    out = o / jnp.maximum(l[..., None], 1e-30)
-    return out.astype(q.dtype)
+    num, s, m_run, _, _ = lax.fori_loop(0, axis_size, ring_step,
+                                        (num, s, m_run, k, v))
+    return (num / jnp.maximum(s, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
@@ -92,8 +104,11 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
 def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
                       scale=None, batch_axis=None):
     """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps the
-    sharded axis from sequence to heads, runs full local attention, then
-    swaps back. Better when H >= axis_size and T is moderate."""
+    sharded axis from sequence to heads, runs full local attention (the
+    fused flash kernel on TPU), then swaps back. Better when H >=
+    axis_size and T is moderate."""
+    from ..ops.flash_attention import flash_attention
+
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
@@ -103,15 +118,8 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
             return lax.all_to_all(x, axis_name, split_axis=split,
                                   concat_axis=concat, tiled=True)
         q2, k2, v2 = (a2a(t, 1, 2) for t in (q, k, v))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q2, k2,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            tq = s.shape[-2]
-            mask = jnp.tril(jnp.ones((tq, tq), bool))
-            s = jnp.where(mask, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, v2.astype(jnp.float32))
-        return a2a(o.astype(q.dtype), 2, 1)
+        o = flash_attention(q2, k2, v2, causal=causal, scale=scale)
+        return a2a(o, 2, 1)
 
     spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
