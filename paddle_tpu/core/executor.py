@@ -198,7 +198,17 @@ class Executor:
     # ------------------------------------------------------------------
     def _run_eager(self, program, feed_arrays, fetch_names, scope,
                    static_info, return_numpy):
-        """Op-by-op eager execution (host-op programs only)."""
+        """Execution path for programs containing host (IO) ops.
+
+        The COMPUTE runs between host ops are jit-compiled per segment and
+        cached (so a pserver-mode trainer's forward+backward is one XLA
+        executable, not an op-by-op interpretation — the reference also
+        only left graph land for the RPC ops themselves,
+        listen_and_serv_op.cc); the host ops execute eagerly between
+        segments on concrete values. Falls back to full op-by-op
+        interpretation when a host op feeds the forward of a grad marker
+        (autodiff must trace through it — e.g. the sparse prefetch path)
+        or when PADDLE_TPU_SEGMENT_COMPILE=0."""
         block = program.global_block()
         ops = list(block.ops)
         persistable = {v.name for v in block.vars.values() if v.persistable}
@@ -224,7 +234,15 @@ class Executor:
             if o.type in ("backward_marker", "calc_gradient_marker"):
                 bwd_idx = i
                 break
-        if bwd_idx is None:
+        host_idx = [i for i, o in enumerate(ops)
+                    if registry.is_host_op(o.type)]
+        segmentable = (_flag_on("PADDLE_TPU_SEGMENT_COMPILE")
+                       and (bwd_idx is None
+                            or all(i > bwd_idx for i in host_idx)))
+        if segmentable:
+            self._run_segments(ctx, ops, bwd_idx, program, block,
+                               static_info, base_key, fetch_names)
+        elif bwd_idx is None:
             for o in ops:
                 _lower_op(ctx, o)
         else:
@@ -243,6 +261,127 @@ class Executor:
         if return_numpy:
             return [as_numpy(v) for v in fetches]
         return fetches
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _is_jit_value(v):
+        return isinstance(v, (jax.Array, np.ndarray, np.generic))
+
+    def _run_segments(self, ctx, ops, bwd_idx, program, block, static_info,
+                      base_key, fetch_names=()):
+        """Interleave jit-compiled compute segments with eager host ops.
+
+        Precondition (checked by the caller): any grad marker precedes the
+        first host op, so each compute segment is traceable in isolation.
+        A compute segment whose inputs include a non-array value (e.g. a
+        SelectedRows produced by a host op) drops to eager for that
+        segment only."""
+        # greedy partition into ("host", [op]) / ("compute", [ops...])
+        segments = []
+        for i, o in enumerate(ops):
+            kind = "host" if registry.is_host_op(o.type) else "compute"
+            if segments and segments[-1][0] == kind == "compute":
+                segments[-1][1].append((i, o))
+            else:
+                segments.append((kind, [(i, o)]))
+
+        # names each segment touches, and what must survive PAST each
+        # segment (later segments' refs + fetches + persistable state +
+        # grad names the marker binds) — the jitted segment returns only
+        # those, so XLA does not materialize every intermediate as output
+        def _names(o):
+            out = set()
+            for coll in (o.inputs, o.outputs):
+                for ns in coll.values():
+                    out.update(ns)
+            return out
+
+        seg_names = [set().union(*(_names(o) for _, o in idx_ops))
+                     for _, idx_ops in segments]
+        persistable = {v.name for v in block.vars.values() if v.persistable}
+        keep = set(fetch_names) | persistable
+        keep |= {n + "@GRAD" for n in keep}
+        needed_after = []
+        acc = set(keep)
+        for names in reversed(seg_names):
+            needed_after.append(set(acc))
+            acc |= names
+        needed_after.reverse()
+
+        check_nan = getattr(ctx, "check_nan", False)
+        from ..amp import amp_enabled
+        for seg_no, (kind, idx_ops) in enumerate(segments):
+            if kind == "host":
+                for _, o in idx_ops:
+                    _lower_op(ctx, o)
+                continue
+            seg_ops = [o for _, o in idx_ops]
+            start = idx_ops[0][0]
+            rel_bwd = None
+            if bwd_idx is not None and start <= bwd_idx:
+                for j, o in enumerate(seg_ops):
+                    if o.type in ("backward_marker",
+                                  "calc_gradient_marker"):
+                        rel_bwd = j
+                        break
+            # a segment touches its ops' inputs AND outputs (outputs that
+            # pre-exist in env: params being updated, feed-op targets),
+            # plus the @LOD companions sequence lowerings read implicitly
+            refs = {n for o in seg_ops
+                    for coll in (o.inputs, o.outputs)
+                    for ns in coll.values() for n in ns}
+            refs |= {n + "@LOD" for n in refs}
+            refs = {n for n in refs if n in ctx.env}
+            if any(not self._is_jit_value(ctx.env[n]) for n in refs):
+                ctx._nan_idx = start
+                if rel_bwd is None:
+                    for _, o in idx_ops:
+                        _lower_op(ctx, o)
+                else:
+                    self._lower_with_grad(ctx, seg_ops, rel_bwd, program,
+                                          block)
+                continue
+            array_env = {k: ctx.env[k] for k in refs}
+            sig = tuple(sorted((k, tuple(np.shape(v)), str(v.dtype))
+                               for k, v in array_env.items()))
+            key = ("segment", program, program._version, seg_no, sig,
+                   check_nan, amp_enabled(),
+                   tuple(sorted(static_info.items())))
+            entry = self._cache.get(key)
+            if entry is None:
+                needed = needed_after[seg_no]
+
+                def seg_fn(array_env, rng_key, _rel_bwd=rel_bwd,
+                           _seg_ops=seg_ops, _start=start, _needed=needed):
+                    n_splits = [0]
+
+                    def seg_rng():
+                        n_splits[0] += 1
+                        return jax.random.fold_in(rng_key, n_splits[0])
+
+                    env = dict(array_env)
+                    sctx = registry.LowerContext(
+                        env, seg_rng, executor=self, block=block,
+                        mesh=getattr(self, "_mesh", None),
+                        static_info=static_info)
+                    sctx.check_nan = check_nan
+                    sctx._nan_idx = _start   # program-order guard keys
+                    if _rel_bwd is None:
+                        for o in _seg_ops:
+                            _lower_op(sctx, o)
+                    else:
+                        self._lower_with_grad(sctx, _seg_ops, _rel_bwd,
+                                              program, block)
+                    return {k: v for k, v in env.items()
+                            if self._is_jit_value(v)
+                            and (k in _needed
+                                 or k.startswith(_NANGUARD)
+                                 or (k.endswith("@LOD")
+                                     and k[:-4] in _needed))}
+
+                entry = self._cache[key] = jax.jit(seg_fn)
+            seg_key = jax.random.fold_in(base_key, 1000 + seg_no)
+            ctx.env.update(entry(array_env, seg_key))
 
     # ------------------------------------------------------------------
     def _build(self, program, feed_names, fetch_names, state_keys,

@@ -12,6 +12,8 @@ Registered flags:
                         (FLAGS_check_nan_inf parity, executor.cc:27-94)
   lod_bucketing   bool  bucket flat LoD totals to powers of two so text
                         batches share compiled steps (SURVEY §7)
+  segment_compile bool  jit the compute runs between host (IO) ops in
+                        host-op programs instead of interpreting op-by-op
   debug_nans      bool  jax_debug_nans — XLA-level NaN tracer (heavier
                         than check_nan_inf; locates the primitive)
   data_home       str   dataset cache directory
@@ -57,6 +59,9 @@ _register("check_nan_inf", bool, False,
           "scan every op output for NaN/Inf inside the compiled step")
 _register("lod_bucketing", bool, True,
           "bucket flat LoD feed totals to the next power of two")
+_register("segment_compile", bool, True,
+          "jit-compile the compute runs between host (IO) ops instead of "
+          "interpreting the whole program op-by-op")
 _register("debug_nans", bool, False,
           "enable jax_debug_nans (XLA-level NaN localization)")
 _register("data_home", str,
