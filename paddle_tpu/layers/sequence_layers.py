@@ -6,7 +6,8 @@ from .layer_helper import LayerHelper
 __all__ = ["sequence_conv", "sequence_pool", "sequence_softmax",
            "sequence_first_step", "sequence_last_step", "sequence_expand",
            "sequence_concat", "sequence_reshape", "sequence_slice",
-           "sequence_erase", "sequence_pad", "sequence_unpad"]
+           "sequence_erase", "sequence_pad", "sequence_unpad",
+           "lod_reset"]
 
 
 def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
@@ -67,6 +68,25 @@ def sequence_expand(x, y, ref_level=-1, name=None):
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(type="sequence_expand", inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]}, attrs={"ref_level": ref_level})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    """Rebind x's LoD from y (its LoD, or its values as offsets) or from
+    the target_lod offset list (layers/nn.py lod_reset parity)."""
+    if y is None and target_lod is None:
+        raise ValueError("lod_reset: y and target_lod should not be "
+                         "both none")
+    helper = LayerHelper("lod_reset", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(
+        type="lod_reset", inputs=inputs, outputs={"Out": [out]},
+        attrs={"target_lod":
+               [int(v) for v in target_lod] if target_lod is not None
+               else []})
     return out
 
 

@@ -150,6 +150,28 @@ def _sequence_expand(ctx, op):
     _set_out_lod(ctx, op, y_lens)
 
 
+@register("lod_reset")
+def _lod_reset(ctx, op):
+    """Rebind a tensor's LoD without touching its data
+    (lod_reset_op.cc): the new per-sequence lengths come from input Y's
+    LoD, from Y's values interpreted as level-0 OFFSETS, or from the
+    ``target_lod`` attr (also offsets, matching the reference API)."""
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", x)
+    y_names = op.input("Y")
+    if y_names:
+        y_lens = ctx.maybe_get(y_names[0] + "@LOD")
+        if y_lens is not None:
+            _set_out_lod(ctx, op, y_lens)
+            return
+        offsets = ctx.in1(op, "Y").reshape(-1)
+    else:
+        offsets = jnp.asarray(op.attr("target_lod") or [], jnp.int32)
+    if offsets.shape[0] >= 2:
+        _set_out_lod(ctx, op, (offsets[1:] - offsets[:-1]).astype(
+            jnp.int32))
+
+
 @register("sequence_reshape")
 def _sequence_reshape(ctx, op):
     x = ctx.in1(op, "X")

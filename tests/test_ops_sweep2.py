@@ -702,6 +702,60 @@ def test_sequence_scatter_op():
                  {}, {"Out": want}, rtol=1e-5)
 
 
+def test_lod_reset_op():
+    # rebind [2,4] lengths to [3,3] via target_lod OFFSETS, then pool:
+    # the downstream sequence op must see the NEW segmentation
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        blk = prog.global_block()
+        for nm in ("x_in", "reset", "pooled"):
+            blk.create_var(name=nm)
+        blk.append_op("lod_reset", {"X": ["x_in"]}, {"Out": ["reset"]},
+                      {"target_lod": [0, 3, 6]})
+        blk.append_op("sequence_pool", {"X": ["reset"]},
+                      {"Out": ["pooled"]}, {"pooltype": "SUM"})
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            pooled, = exe.run(
+                prog, feed={"x_in": fluid.LoDTensor(x, [[0, 2, 6]])},
+                fetch_list=["pooled"])
+    want = np.stack([x[:3].sum(0), x[3:].sum(0)])
+    np.testing.assert_allclose(np.asarray(pooled), want)
+
+    # Y-input form: Out must ADOPT Y's LoD — prove it via a chained pool
+    def pooled_after_reset(y_val, y_feed_key, feed_extra):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            blk = prog.global_block()
+            for nm in ("x_in2", y_feed_key, "reset2", "pooled2"):
+                blk.create_var(name=nm)
+            blk.append_op("lod_reset",
+                          {"X": ["x_in2"], "Y": [y_feed_key]},
+                          {"Out": ["reset2"]}, {})
+            blk.append_op("sequence_pool", {"X": ["reset2"]},
+                          {"Out": ["pooled2"]}, {"pooltype": "SUM"})
+            exe = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(fluid.Scope()):
+                out, = exe.run(
+                    prog,
+                    feed={"x_in2": fluid.LoDTensor(x, [[0, 2, 6]]),
+                          y_feed_key: y_val, **feed_extra},
+                    fetch_list=["pooled2"])
+        return np.asarray(out)
+
+    # (a) Y carries a LoD: lengths [1, 5] replace x's [2, 4]
+    y = fluid.LoDTensor(np.zeros((6, 1), np.float32), [[0, 1, 6]])
+    got = pooled_after_reset(y, "y_lod", {})
+    np.testing.assert_allclose(got, np.stack([x[:1].sum(0), x[1:].sum(0)]))
+
+    # (b) Y without LoD: its VALUES are level-0 offsets
+    y_off = np.array([0, 4, 6], np.int32)
+    got = pooled_after_reset(y_off, "y_off", {})
+    np.testing.assert_allclose(got, np.stack([x[:4].sum(0), x[4:].sum(0)]))
+
+
 def test_sequence_slice_op():
     # per-sequence sub-slices: seq0 = rows 0-2 (take offset 1 len 2),
     # seq1 = rows 3-6 (take offset 0 len 1)

@@ -94,6 +94,13 @@ def test_flagship_moe_ep_parity():
             {"dp": 2, "ep": 4}, num_experts=4)
 
 
+def test_flagship_3d_dp_tp_sp_parity():
+    # 3-axis composition on one mesh: batch on dp, Megatron weight shards
+    # on tp, ring attention over sp — all through the same Program
+    _parity(parallel.DistributedStrategy(dp=2, tp=2, sp=2),
+            {"dp": 2, "tp": 2, "sp": 2}, rtol=5e-4)
+
+
 def test_sp_attention_op_matches_dense_numpy(rng):
     b, h, t, d = 2, 2, 8, 4
     qv = rng.randn(b, h, t, d).astype(np.float32)
