@@ -385,8 +385,17 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _build(self, program, feed_names, fetch_names, state_keys,
-               static_info=None, check_nan=False):
-        """Build the pure step function for one (program, signature)."""
+               static_info=None, check_nan=False, accum_steps=1):
+        """Build the pure step function for one (program, signature).
+
+        accum_steps > 1: GRADIENT ACCUMULATION — the feed batch is split
+        into that many microbatches, fwd+bwd runs as a lax.scan over them
+        accumulating mean grads (and streaming persistable-state updates,
+        e.g. batch-norm counters), then the optimizer ops apply once.
+        In-graph, so one XLA executable per step regardless of
+        accum_steps. Requires a grad marker and non-LoD feeds; only
+        targets and persistables are fetchable (microbatch intermediates
+        never leave the scan)."""
         static_info = static_info or {}
         block = program.global_block()
         ops = list(block.ops)
@@ -398,6 +407,23 @@ class Executor:
             if op.type in ("backward_marker", "calc_gradient_marker"):
                 bwd_idx = i
                 break
+        if accum_steps > 1:
+            if bwd_idx is None:
+                raise ValueError(
+                    "gradient_accumulation_steps=%d needs a grad marker "
+                    "(append_backward/minimize) in the program"
+                    % accum_steps)
+            if ops[bwd_idx].type != "backward_marker":
+                # calc_gradient targets are SUM-reduced with unit
+                # cotangents; microbatch-mean accumulation would change
+                # both scale and (for non-scalar targets) shape
+                raise NotImplementedError(
+                    "gradient accumulation supports loss training "
+                    "(append_backward) only, not calc_gradient")
+            if any(n.endswith("@LOD") for n in feed_names):
+                raise ValueError(
+                    "gradient accumulation does not support LoD feeds "
+                    "(ragged microbatch splits are data-dependent)")
 
         def step(state, feeds, rng_key):
             n_splits = [0]
@@ -414,7 +440,11 @@ class Executor:
                                         mesh=getattr(self, "_mesh", None),
                                         static_info=static_info)
             ctx.check_nan = check_nan
-            if bwd_idx is None:
+            if accum_steps > 1:
+                self._lower_with_grad_accum(ctx, ops, bwd_idx, block,
+                                            feeds, accum_steps,
+                                            persistable_names)
+            elif bwd_idx is None:
                 for op in ops:
                     _lower_op(ctx, op)
             else:
@@ -454,16 +484,21 @@ class Executor:
         return out
 
     @staticmethod
+    def _parse_marker(marker):
+        """Grad-marker attrs → (wrt_names, target_names)."""
+        if marker.type == "backward_marker":
+            return (marker.attr("param_names") or [],
+                    [marker.attr("loss_name")])
+        # calc_gradient_marker
+        return (marker.attr("input_names") or [],
+                marker.attr("target_names") or [])
+
+    @staticmethod
     def _lower_with_grad(ctx, ops, bwd_idx, program, block):
         """Trace forward ops under value_and_grad, bind param@GRAD vars, then
         trace the remaining (optimizer) ops."""
         marker = ops[bwd_idx]
-        if marker.type == "backward_marker":
-            wrt_names = marker.attr("param_names") or []
-            target_names = [marker.attr("loss_name")]
-        else:  # calc_gradient_marker
-            wrt_names = marker.attr("input_names") or []
-            target_names = marker.attr("target_names") or []
+        wrt_names, target_names = Executor._parse_marker(marker)
         base_env = dict(ctx.env)
         wrt = {n: base_env[n] for n in wrt_names if n in base_env}
 
@@ -500,6 +535,105 @@ class Executor:
             ctx.env[target_names[0] + "@GRAD"] = jnp.ones_like(loss_val)
         for p, g in grads.items():
             ctx.env[p + "@GRAD"] = g
+        for op in ops[bwd_idx + 1:]:
+            _lower_op(ctx, op)
+
+    @staticmethod
+    def _lower_with_grad_accum(ctx, ops, bwd_idx, block, feeds,
+                               accum_steps, persistable_names):
+        """Gradient accumulation: lax.scan of fwd+bwd over microbatches.
+
+        Feeds with batch dim > 1 split into accum_steps equal chunks
+        (scalar / leading-dim-1 feeds broadcast to every microbatch); the
+        scan carry holds (grad sums, loss sum, persistable state) so
+        streaming forward-state updates (e.g. batch-norm counters) and
+        NaN guards thread through microbatches; grads and the loss are
+        MEANS over microbatches — for a mean-reduced loss this equals the
+        full-batch gradient, so an optimizer step after accumulation
+        matches the unaccumulated step. Each microbatch gets its own RNG
+        stream (dropout masks differ per microbatch)."""
+        wrt_names, target_names = Executor._parse_marker(ops[bwd_idx])
+        base_env = dict(ctx.env)
+        wrt = {n: base_env[n] for n in wrt_names if n in base_env}
+
+        k = int(accum_steps)
+        chunked = {}
+        for n in feeds:
+            v = base_env[n]
+            if getattr(v, "ndim", 0) < 1 or v.shape[0] <= 1:
+                continue          # scalar/broadcast feed: replicate
+            if v.shape[0] % k:
+                raise ValueError(
+                    "feed %r batch dim %s not divisible into %d "
+                    "microbatches" % (n, getattr(v, "shape", ()), k))
+            chunked[n] = v.reshape((k, v.shape[0] // k) + v.shape[1:])
+        # persistable values the forward may update (streamed through the
+        # scan carry; keys fixed before tracing for a stable carry pytree)
+        pstate0 = {n: v for n, v in base_env.items()
+                   if n in persistable_names and n not in wrt}
+        accum_key = ctx._rng_fn()    # base for per-microbatch streams
+
+        def forward(params, pstate, feeds_i, key_i):
+            env = dict(base_env)
+            env.update(pstate)
+            env.update(feeds_i)
+            env.update(params)
+            n_splits = [0]
+
+            def micro_rng():
+                n_splits[0] += 1
+                return jax.random.fold_in(key_i, n_splits[0])
+
+            fctx = registry.LowerContext(env, micro_rng,
+                                         is_test=ctx.is_test,
+                                         executor=ctx.executor,
+                                         block=block, mesh=ctx.mesh,
+                                         static_info=ctx.static_info)
+            fctx.check_nan = getattr(ctx, "check_nan", False)
+            for op in ops[:bwd_idx]:
+                _lower_op(fctx, op)
+            loss = env[target_names[0]]
+            return (loss if loss.ndim == 0 else jnp.sum(loss)), env
+
+        def body(carry, xs):
+            gsum, lsum, pstate, guards_ok = carry
+            feeds_i, idx = xs
+            key_i = jax.random.fold_in(accum_key, idx)
+            (loss, env_a), grads = jax.value_and_grad(
+                forward, has_aux=True)(wrt, pstate, feeds_i, key_i)
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            lsum = lsum + loss
+            pstate = {n: env_a.get(n, pstate[n]) for n in pstate}
+            guards_ok = {g: guards_ok[g]
+                         & env_a.get(g, jnp.asarray(True))
+                         for g in guards_ok}
+            return (gsum, lsum, pstate, guards_ok), None
+
+        # one probe trace discovers the guard names so the carry pytree is
+        # fixed; under jit this trace is free (dead code) — only the scan
+        # below reaches the output
+        _, probe_env = forward(wrt, pstate0,
+                               {n: c[0] for n, c in chunked.items()},
+                               accum_key)
+        guard_names = [g for g in probe_env if g.startswith(_NANGUARD)]
+        init = (jax.tree.map(jnp.zeros_like, wrt),
+                jnp.zeros_like(probe_env[target_names[0]],
+                               shape=()),
+                pstate0,
+                {g: jnp.asarray(True) for g in guard_names})
+        (gsum, lsum, pstate, guards_ok), _ = jax.lax.scan(
+            body, init, (chunked, jnp.arange(k)))
+
+        ctx.env.update(pstate)
+        ctx.env.update(guards_ok)
+        loss_name = target_names[0]
+        ctx.env[loss_name] = lsum / k
+        fwd_guard_idx = [int(g[len(_NANGUARD):].split("|", 1)[0])
+                         for g in guard_names]
+        ctx._nan_idx = max(fwd_guard_idx, default=-1) + 1
+        ctx.env[loss_name + "@GRAD"] = jnp.ones_like(lsum)
+        for p in wrt:
+            ctx.env[p + "@GRAD"] = gsum[p] / k
         for op in ops[bwd_idx + 1:]:
             _lower_op(ctx, op)
 

@@ -24,7 +24,8 @@ from ..core.lod import LoDTensor
 class ParallelExecutor:
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  share_vars_from=None, num_trainers=1, trainer_id=0,
-                 mesh=None, scope=None, use_tpu=True, **kwargs):
+                 mesh=None, scope=None, use_tpu=True, strategy=None,
+                 **kwargs):
         # `use_cuda` is accepted as the reference's legacy "use accelerator"
         # flag; device choice here is the mesh's. Anything we can't honor is
         # rejected loudly instead of silently dropped.
@@ -61,6 +62,15 @@ class ParallelExecutor:
         self._exe._mesh = self.mesh   # lowerings (sp/pp/ep ops) read this
         self._cache = {}
         self._loss_name = loss_name
+        # DistributedStrategy execution knobs (mesh axes are consumed by
+        # the model builders; these two belong to the executor)
+        self._accum_steps = max(
+            1, int(getattr(strategy, "gradient_accumulation_steps", 1)))
+        # use_bf16_compute=True pins AMP on for THIS executor's traces
+        # (restored after each build — the global flag is not leaked);
+        # False (the default) leaves the ambient AMP setting alone
+        self._force_bf16 = bool(getattr(strategy, "use_bf16_compute",
+                                        False)) or None
 
     @property
     def device_count(self):
@@ -111,17 +121,32 @@ class ParallelExecutor:
         hints = tuple(sorted(
             (k, tuple(v)) for k, v in program._sharding_hints.items()))
         from ..core.executor import _flag_on
+        from ..amp import amp_enabled, enable_amp
         check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
+        use_amp = self._force_bf16 if self._force_bf16 is not None \
+            else amp_enabled()
         key = (program, program._version, _feed_signature(feed_arrays),
-               fetch_names, state_keys, hints, check_nan,
-               tuple(sorted(static_info.items())))
+               fetch_names, state_keys, hints, check_nan, use_amp,
+               self._accum_steps, tuple(sorted(static_info.items())))
         entry = self._cache.get(key)
         repl = NamedSharding(self.mesh, PartitionSpec())
         if entry is None:
-            fn = self._exe._build(program, tuple(sorted(feed_arrays)),
-                                  fetch_names, state_keys,
-                                  static_info=static_info,
-                                  check_nan=check_nan)
+            built = self._exe._build(program, tuple(sorted(feed_arrays)),
+                                     fetch_names, state_keys,
+                                     static_info=static_info,
+                                     check_nan=check_nan,
+                                     accum_steps=self._accum_steps)
+
+            def fn(state, feeds, key, _fn=built, _amp=use_amp):
+                # lowering reads the AMP flag at TRACE time; pin it for
+                # the trace and restore the ambient value (no global leak)
+                prev = amp_enabled()
+                enable_amp(_amp)
+                try:
+                    return _fn(state, feeds, key)
+                finally:
+                    enable_amp(prev)
+
             data_sh = self._data_sharding()
             state_sh = {n: self._state_sharding(n) for n in state_keys}
             in_shardings = (state_sh,

@@ -1,0 +1,98 @@
+"""Gradient accumulation (DistributedStrategy.gradient_accumulation_steps
+→ core/executor.py _lower_with_grad_accum).
+
+The feed batch splits into k microbatches scanned in-graph; grads and
+targets are means over microbatches. For a mean-reduced loss this equals
+the full-batch gradient, so one accumulated step must match one
+unaccumulated step on the same feeds — params, loss, everything.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import parallel
+
+
+def _build_net(seed=3):
+    x = fluid.layers.data("x", [8])
+    y = fluid.layers.data("y", [1])
+    h = fluid.layers.fc(x, 16, act="tanh")
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _train(accum_steps, steps=3):
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 8).astype(np.float32)
+    yv = rng.rand(16, 1).astype(np.float32)
+
+    from paddle_tpu.core import unique_name
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard("ga_"):
+        loss = _build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        strategy = parallel.DistributedStrategy(
+            gradient_accumulation_steps=accum_steps)
+        pexe = fluid.ParallelExecutor(loss_name=loss.name,
+                                      main_program=main, scope=scope,
+                                      strategy=strategy)
+        losses = [float(np.asarray(
+            pexe.run([loss], feed={"x": xv, "y": yv})[0]))
+            for _ in range(steps)]
+        params = {v.name: np.asarray(scope.find_var(v.name)).copy()
+                  for v in main.global_block().vars.values()
+                  if v.persistable and scope.find_var(v.name) is not None}
+    return losses, params
+
+
+def test_accumulated_step_matches_full_batch():
+    losses1, params1 = _train(accum_steps=1)
+    losses4, params4 = _train(accum_steps=4)
+    np.testing.assert_allclose(losses4, losses1, rtol=1e-5)
+    assert params1.keys() == params4.keys()
+    for n in params1:
+        np.testing.assert_allclose(params4[n], params1[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+    # and training moved the loss
+    assert losses1[-1] < losses1[0]
+
+
+def test_accumulation_requires_divisible_batch():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss = _build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope,
+            strategy=parallel.DistributedStrategy(
+                gradient_accumulation_steps=3))
+        x = np.zeros((16, 8), np.float32)
+        y = np.zeros((16, 1), np.float32)
+        with pytest.raises(ValueError, match="microbatch"):
+            pexe.run([loss], feed={"x": x, "y": y})
+
+
+def test_accumulation_requires_grad_marker():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", [4])
+        out = fluid.layers.fc(x, 2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(
+            loss_name=None, main_program=main, scope=scope,
+            strategy=parallel.DistributedStrategy(
+                gradient_accumulation_steps=2))
+        b = pexe.device_count * 2
+        with pytest.raises(ValueError, match="grad marker"):
+            pexe.run([out], feed={"x": np.zeros((b, 4), np.float32)})
