@@ -609,16 +609,25 @@ class Executor:
                          for g in guards_ok}
             return (gsum, lsum, pstate, guards_ok), None
 
-        # one probe trace discovers the guard names so the carry pytree is
-        # fixed; under jit this trace is free (dead code) — only the scan
-        # below reaches the output
+        # One probe trace on microbatch 0: discovers the guard names (so
+        # the scan carry pytree is fixed) and supplies the post-marker
+        # ops' forward inputs — e.g. a computed learning-rate chain. Only
+        # the subgraph whose outputs are actually exported below survives
+        # XLA dead-code elimination; the heavy model compute in the probe
+        # is dropped.
         _, probe_env = forward(wrt, pstate0,
                                {n: c[0] for n, c in chunked.items()},
                                accum_key)
+        loss_name = target_names[0]
+        if getattr(probe_env[loss_name], "ndim", 0) != 0:
+            raise ValueError(
+                "gradient accumulation requires a SCALAR (mean-reduced) "
+                "loss; %r has shape %s — accumulating a per-element loss "
+                "would silently rescale gradients by 1/%d"
+                % (loss_name, probe_env[loss_name].shape, k))
         guard_names = [g for g in probe_env if g.startswith(_NANGUARD)]
         init = (jax.tree.map(jnp.zeros_like, wrt),
-                jnp.zeros_like(probe_env[target_names[0]],
-                               shape=()),
+                jnp.zeros_like(probe_env[loss_name], shape=()),
                 pstate0,
                 {g: jnp.asarray(True) for g in guard_names})
         (gsum, lsum, pstate, guards_ok), _ = jax.lax.scan(
@@ -626,7 +635,43 @@ class Executor:
 
         ctx.env.update(pstate)
         ctx.env.update(guards_ok)
-        loss_name = target_names[0]
+        # Post-marker (optimizer) ops may read forward intermediates —
+        # the computed-LR chain is the canonical case. Export those from
+        # the PROBE trace, and for persistable vars that chain writes
+        # (step counters: @LR_DECAY_COUNTER@) export the probe's
+        # once-advanced value too, overriding the scan's k-advanced copy:
+        # a counter's contract is one tick per executed STEP, while
+        # batch-norm-style stats (not read post-marker) keep the
+        # per-microbatch streamed values from the scan.
+        post_in = {n for op in ops[bwd_idx + 1:]
+                   for ns in op.inputs.values() for n in ns}
+        producers = {}
+        for op in ops[:bwd_idx]:
+            for ns in op.outputs.values():
+                for n in ns:
+                    producers[n] = op
+        frontier = [n for n in post_in
+                    if n in producers and n in probe_env
+                    and n not in base_env]
+        seen_ops, stack = set(), list(frontier)
+        counter_vars = set()
+        while stack:
+            nm = stack.pop()
+            op = producers.get(nm)
+            if op is None or id(op) in seen_ops:
+                continue
+            seen_ops.add(id(op))
+            for ns in op.outputs.values():
+                counter_vars.update(n for n in ns
+                                    if n in persistable_names)
+            for ns in op.inputs.values():
+                stack.extend(ns)
+        for n in frontier:
+            ctx.env[n] = probe_env[n]
+        for n in counter_vars:
+            if n in probe_env:
+                ctx.env[n] = probe_env[n]
+
         ctx.env[loss_name] = lsum / k
         fwd_guard_idx = [int(g[len(_NANGUARD):].split("|", 1)[0])
                          for g in guard_names]

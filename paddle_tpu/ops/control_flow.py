@@ -177,10 +177,13 @@ def _select_rows_by_mask(ctx, op):
 
 
 # -- LoDTensorArray ops (tensor_array_read_write.cc, lod_array_length) -----
-# Arrays are represented as stacked tensors in env plus a python-side list
-# during tracing when indices are trace-time constants.
+# Arrays are represented as a python-side list in env. Indices must be
+# trace-time constants, so STANDALONE (block-0) usage is host-tier: the
+# Executor routes such programs through the interpreter, where indices
+# are concrete (While/StaticRNN sub-blocks supply python ints during
+# their own lowering and are unaffected by the host marking).
 
-@register("write_to_array")
+@register("write_to_array", host=True)
 def _write_to_array(ctx, op):
     arr_name = ctx.out_name(op, "Out")
     x = ctx.in1(op, "X")
@@ -203,7 +206,7 @@ def _write_to_array(ctx, op):
     ctx.env[arr_name] = lst
 
 
-@register("read_from_array")
+@register("read_from_array", host=True)
 def _read_from_array(ctx, op):
     arr_name = op.input("X")[0]
     i = ctx.in1(op, "I")
@@ -215,7 +218,7 @@ def _read_from_array(ctx, op):
     ctx.set_out(op, "Out", lst[idx])
 
 
-@register("lod_array_length")
+@register("lod_array_length", host=True)
 def _lod_array_length(ctx, op):
     arr_name = op.input("X")[0]
     lst = ctx.env.get(arr_name + "@ARRAY")

@@ -64,6 +64,78 @@ def test_accumulated_step_matches_full_batch():
     assert losses1[-1] < losses1[0]
 
 
+def _train_sched(accum_steps, steps=4):
+    """Computed learning rate (exponential decay) + accumulation: the LR
+    chain is a forward intermediate read by the optimizer, and its step
+    counter must tick once per STEP, not once per microbatch."""
+    from paddle_tpu.core import unique_name
+    rng = np.random.RandomState(1)
+    xv = rng.rand(16, 8).astype(np.float32)
+    yv = rng.rand(16, 1).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard("gs_"):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        lr = fluid.layers.exponential_decay(
+            learning_rate=0.2, decay_steps=2, decay_rate=0.5,
+            staircase=True)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope,
+            strategy=parallel.DistributedStrategy(
+                gradient_accumulation_steps=accum_steps))
+        losses = [float(np.asarray(
+            pexe.run([loss], feed={"x": xv, "y": yv})[0]))
+            for _ in range(steps)]
+        params = {v.name: np.asarray(scope.find_var(v.name)).copy()
+                  for v in main.global_block().vars.values()
+                  if v.persistable and scope.find_var(v.name) is not None}
+        counter_name, = [n for n in params if "@LR_DECAY_COUNTER@" in n]
+        counter = params[counter_name]
+    return losses, counter, params
+
+
+def test_accumulation_with_lr_schedule_matches_and_ticks_once():
+    l1, c1, p1 = _train_sched(accum_steps=1)
+    l2, c2, p2 = _train_sched(accum_steps=2)
+    np.testing.assert_allclose(l2, l1, rtol=1e-5)
+    # the decay counter advanced once per STEP in both configurations —
+    # under the per-microbatch-tick bug c2 would be ~2x c1
+    np.testing.assert_array_equal(c1, c2)
+    assert int(np.asarray(c1).ravel()[0]) > 0
+    for n in p1:
+        np.testing.assert_allclose(p2[n], p1[n], rtol=1e-5, atol=1e-6,
+                                   err_msg=n)
+
+
+def test_accumulation_rejects_non_scalar_loss():
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = fluid.Scope()
+    with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.square_error_cost(pred, y)
+        fluid.append_backward(loss)                      # non-scalar target
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        pexe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main2, scope=scope2,
+            strategy=parallel.DistributedStrategy(
+                gradient_accumulation_steps=2))
+        b = pexe.device_count * 2
+        with pytest.raises(ValueError, match="SCALAR"):
+            pexe.run([loss], feed={"x": np.ones((b, 8), np.float32),
+                                   "y": np.ones((b, 1), np.float32)})
+
+
 def test_accumulation_requires_divisible_batch():
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()

@@ -525,14 +525,15 @@ def test_tensor_array_write_read_length():
     blk.create_var(name="alen")
     blk.append_op("lod_array_length", {"X": ["arr"]}, {"Out": ["alen"]}, {})
     exe = fluid.Executor(fluid.CPUPlace())
-    # TensorArray indices must be trace-time constants; standalone (outside a
-    # While loop, which supplies python ints) they need the eager interpreter
+    # TensorArray indices must be trace-time constants: the array ops are
+    # host-tier, so the PUBLIC run() path routes this program through the
+    # interpreter (index-producing segments still compile)
     with fluid.scope_guard(fluid.Scope()):
-        r, n = exe._run_eager(
+        r, n = exe.run(
             prog,
-            {"x0": np.ones((2, 3), np.float32),
-             "x1": 2 * np.ones((2, 3), np.float32)},
-            ("read1", "alen"), fluid.Scope(), {}, True)
+            feed={"x0": np.ones((2, 3), np.float32),
+                  "x1": 2 * np.ones((2, 3), np.float32)},
+            fetch_list=["read1", "alen"])
     np.testing.assert_allclose(np.asarray(r), 2.0)
     assert int(np.asarray(n)[0]) == 2
 
