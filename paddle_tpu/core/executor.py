@@ -496,11 +496,27 @@ class Executor:
     @staticmethod
     def _lower_with_grad(ctx, ops, bwd_idx, program, block):
         """Trace forward ops under value_and_grad, bind param@GRAD vars, then
-        trace the remaining (optimizer) ops."""
+        trace the remaining (optimizer) ops.
+
+        ``append_backward(..., checkpoint=True)`` wraps the WHOLE forward
+        in jax.checkpoint: only the step inputs are saved and the forward
+        re-runs during the backward pass (maximal memory saving, ~1.33x
+        forward FLOPs). In that mode only targets, persistables, @LOD
+        lengths and guards survive the forward — fetching another forward
+        intermediate would defeat the remat, so it raises a KeyError at
+        fetch. Per-layer granularity is ``layers.recompute()``."""
         marker = ops[bwd_idx]
         wrt_names, target_names = Executor._parse_marker(marker)
         base_env = dict(ctx.env)
         wrt = {n: base_env[n] for n in wrt_names if n in base_env}
+        use_ckpt = bool(marker.attr("checkpoint")) \
+            if marker.type == "backward_marker" else False
+        persistable = {v.name for v in block.vars.values()
+                       if v.persistable}
+        # post-marker (optimizer) ops may read forward intermediates —
+        # computed learning-rate chains — so those survive the keep filter
+        post_in = {n for op in ops[bwd_idx + 1:]
+                   for ns in op.inputs.values() for n in ns}
 
         def forward(params):
             env = dict(base_env)
@@ -520,10 +536,20 @@ class Executor:
             for tn in target_names:
                 t = env[tn]
                 total = total + (t if t.ndim == 0 else jnp.sum(t))
-            return total, env
+            if not use_ckpt:
+                return total, env
+            # checkpointed: exporting every intermediate as an output
+            # would force XLA to store them all — keep only what the
+            # post-marker ops and the scope commit can need
+            keep = {n: v for n, v in env.items()
+                    if n in persistable or n in target_names
+                    or n in wrt or n in post_in
+                    or n.startswith(_NANGUARD) or n.endswith("@LOD")}
+            return total, keep
 
+        fwd = jax.checkpoint(forward) if use_ckpt else forward
         (loss_val, env_after), grads = jax.value_and_grad(
-            forward, has_aux=True)(wrt)
+            fwd, has_aux=True)(wrt)
         ctx.env.update(env_after)
         # continue the NaN-guard program-order index past the forward ops
         # (the forward fctx numbered its guards from 0; optimizer-op guards
@@ -552,9 +578,13 @@ class Executor:
         full-batch gradient, so an optimizer step after accumulation
         matches the unaccumulated step. Each microbatch gets its own RNG
         stream (dropout masks differ per microbatch)."""
-        wrt_names, target_names = Executor._parse_marker(ops[bwd_idx])
+        marker = ops[bwd_idx]
+        wrt_names, target_names = Executor._parse_marker(marker)
         base_env = dict(ctx.env)
         wrt = {n: base_env[n] for n in wrt_names if n in base_env}
+        use_ckpt = bool(marker.attr("checkpoint"))
+        post_in = {n for o in ops[bwd_idx + 1:]
+                   for ns in o.inputs.values() for n in ns}
 
         k = int(accum_steps)
         chunked = {}
@@ -593,14 +623,24 @@ class Executor:
             for op in ops[:bwd_idx]:
                 _lower_op(fctx, op)
             loss = env[target_names[0]]
+            if use_ckpt:
+                # checkpoint composes with accumulation: per-microbatch
+                # residuals shrink to the microbatch inputs; keep only
+                # what the carry/probe consumers read (the whole-forward
+                # keep-filter contract of _lower_with_grad)
+                env = {n: v for n, v in env.items()
+                       if n in pstate0 or n in target_names
+                       or n in post_in or n.startswith(_NANGUARD)}
             return (loss if loss.ndim == 0 else jnp.sum(loss)), env
+
+        fwd = jax.checkpoint(forward) if use_ckpt else forward
 
         def body(carry, xs):
             gsum, lsum, pstate, guards_ok = carry
             feeds_i, idx = xs
             key_i = jax.random.fold_in(accum_key, idx)
             (loss, env_a), grads = jax.value_and_grad(
-                forward, has_aux=True)(wrt, pstate, feeds_i, key_i)
+                fwd, has_aux=True)(wrt, pstate, feeds_i, key_i)
             gsum = jax.tree.map(jnp.add, gsum, grads)
             lsum = lsum + loss
             pstate = {n: env_a.get(n, pstate[n]) for n in pstate}
@@ -643,8 +683,6 @@ class Executor:
         # a counter's contract is one tick per executed STEP, while
         # batch-norm-style stats (not read post-marker) keep the
         # per-microbatch streamed values from the scan.
-        post_in = {n for op in ops[bwd_idx + 1:]
-                   for ns in op.inputs.values() for n in ns}
         producers = {}
         for op in ops[:bwd_idx]:
             for ns in op.outputs.values():

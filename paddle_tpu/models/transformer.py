@@ -171,7 +171,7 @@ def make_attn_bias(mask_2d, n_head, causal=False, seq_len=None):
 
 def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
                    d_model=512, d_inner=2048, dropout_rate=0.0,
-                   label_smooth_eps=0.0, packed=False):
+                   label_smooth_eps=0.0, packed=False, recompute=False):
     """Decoder-only LM (flagship bench model). Feeds: src [B,T] int64,
     pos [B,T] int64, mask [B,T] float32, label [B,T] int64.
     Returns (avg_cost, logits).
@@ -179,7 +179,10 @@ def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
     packed=True assumes full-length (packed) sequences — the standard LM
     pretraining layout — and drops the padding half of the attention bias
     so self-attention runs through the fused flash path; `mask` still
-    weights the loss."""
+    weights the loss. recompute=True wraps each decoder layer in a
+    layers.recompute() region (jax.checkpoint): layer activations are
+    recomputed in the backward pass, trading ~1/3 extra forward FLOPs
+    for activation memory — the long-context lever."""
     d_key = d_value = d_model // n_head
     src = layers.data("src", [max_len], dtype="int64")
     pos = layers.data("pos", [max_len], dtype="int64")
@@ -190,10 +193,12 @@ def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
     if dropout_rate:
         x = layers.dropout(x, dropout_prob=dropout_rate)
     bias = None if packed else make_attn_bias(mask, n_head, causal=True)
+    import contextlib
     for _ in range(n_layer):
-        x = decoder_layer(x, None, bias, None, n_head, d_key, d_value,
-                          d_model, d_inner, dropout_rate,
-                          causal=packed)
+        with layers.recompute() if recompute else contextlib.nullcontext():
+            x = decoder_layer(x, None, bias, None, n_head, d_key, d_value,
+                              d_model, d_inner, dropout_rate,
+                              causal=packed)
     logits = layers.fc(x, vocab_size, num_flatten_dims=2, bias_attr=False)
 
     b, t = logits.shape[0], logits.shape[1]

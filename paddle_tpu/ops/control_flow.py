@@ -164,6 +164,79 @@ def _conditional_block(ctx, op):
         ctx.env[n] = v
 
 
+@register("recompute_block")
+def _recompute_block(ctx, op):
+    """Rematerialization region: lower the sub-block under jax.checkpoint
+    so its INTERNAL activations are recomputed during the backward pass
+    instead of stored — the TPU realization of the reference era's
+    memory-optimization capability (memory_optimization_transpiler.py),
+    done by the AD system rather than liveness analysis. Grads flow
+    through the region; RNG-consuming ops (dropout) reuse one region key,
+    so the recompute replays identical masks.
+
+    Outputs exported from the region are the sub-block writes consumed by
+    LATER ops of the parent block (plus persistables); an intermediate
+    that is only fetched would defeat the remat, so it is not exported —
+    fetch it outside a recompute region instead."""
+    from ..core.executor import _lower_op, _NANGUARD
+
+    block = op.attr("sub_block")
+    parent_ops = list(ctx.block.ops) if ctx.block is not None else []
+    try:
+        my_idx = next(i for i, o in enumerate(parent_ops) if o is op)
+    except StopIteration:
+        raise RuntimeError(
+            "recompute_block op not found in its parent block's op list "
+            "— the lowering must run on the block that owns the op")
+    # the layer records external reads/writes as real op inputs/outputs,
+    # so this scan sees through later recompute regions too
+    later_reads = {n for o in parent_ops[my_idx + 1:]
+                   for ns in o.inputs.values() for n in ns}
+    persistable = {v.name for v in ctx.block.vars.values()
+                   if getattr(v, "persistable", False)} \
+        if ctx.block is not None else set()
+    out_names = [n for n in op.output("Out")
+                 if n in later_reads or n in persistable]
+    in_names = [n for n in op.input("X") if n in ctx.env]
+
+    base_env = dict(ctx.env)
+    region_key = ctx._rng_fn()
+    guard_start = getattr(ctx, "_nan_idx", 0)
+
+    def f(vals, key):
+        env = dict(base_env)
+        env.update(zip(in_names, vals))
+        counter = [0]
+
+        def rfn():
+            counter[0] += 1
+            return jax.random.fold_in(key, counter[0])
+
+        sctx = LowerContext(env, rfn, is_test=ctx.is_test,
+                            executor=ctx.executor, block=block,
+                            mesh=ctx.mesh, static_info=ctx.static_info)
+        sctx.check_nan = getattr(ctx, "check_nan", False)
+        sctx._nan_idx = guard_start   # program-order guard keys continue
+        for op2 in block.ops:
+            _lower_op(sctx, op2)
+        # exports: region outputs + their @LOD lengths (sequence ops
+        # inside the region may have changed them) + per-op NaN guards
+        # (the every-op-output contract holds inside regions too)
+        lods = {n + "@LOD": env[n + "@LOD"] for n in out_names
+                if env.get(n + "@LOD") is not None}
+        guards = {k: v for k, v in env.items()
+                  if k.startswith(_NANGUARD) and k not in base_env}
+        return tuple(env[n] for n in out_names), lods, guards
+
+    outs, lods, guards = jax.checkpoint(f)(
+        tuple(ctx.env[n] for n in in_names), region_key)
+    for n, v in zip(out_names, outs):
+        ctx.env[n] = v
+    ctx.env.update(lods)
+    ctx.env.update(guards)
+    ctx._nan_idx = guard_start + len(guards)
+
+
 @register("select_rows_by_mask")
 def _select_rows_by_mask(ctx, op):
     """Row-wise merge for IfElse (the static-shape replacement for the
