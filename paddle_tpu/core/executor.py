@@ -227,7 +227,8 @@ class Executor:
 
         ctx = registry.LowerContext(env, rng_fn, executor=self, block=block,
                                     mesh=getattr(self, "_mesh", None),
-                                    static_info=static_info)
+                                    static_info=static_info,
+                                    fetch_names=fetch_names)
         ctx.check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
         bwd_idx = None
         for i, o in enumerate(ops):
@@ -363,7 +364,8 @@ class Executor:
                     sctx = registry.LowerContext(
                         env, seg_rng, executor=self, block=block,
                         mesh=getattr(self, "_mesh", None),
-                        static_info=static_info)
+                        static_info=static_info,
+                        fetch_names=getattr(ctx, "fetch_names", ()))
                     sctx.check_nan = check_nan
                     sctx._nan_idx = _start   # program-order guard keys
                     if _rel_bwd is None:
@@ -438,7 +440,8 @@ class Executor:
             ctx = registry.LowerContext(env, rng_fn, executor=self,
                                         block=block,
                                         mesh=getattr(self, "_mesh", None),
-                                        static_info=static_info)
+                                        static_info=static_info,
+                                        fetch_names=fetch_names)
             ctx.check_nan = check_nan
             if accum_steps > 1:
                 self._lower_with_grad_accum(ctx, ops, bwd_idx, block,
@@ -525,7 +528,9 @@ class Executor:
                                          is_test=ctx.is_test,
                                          executor=ctx.executor, block=block,
                                          mesh=ctx.mesh,
-                                         static_info=ctx.static_info)
+                                         static_info=ctx.static_info,
+                                         fetch_names=getattr(
+                                             ctx, "fetch_names", ()))
             fctx.check_nan = getattr(ctx, "check_nan", False)
             for op in ops[:bwd_idx]:
                 _lower_op(fctx, op)
@@ -618,7 +623,9 @@ class Executor:
                                          is_test=ctx.is_test,
                                          executor=ctx.executor,
                                          block=block, mesh=ctx.mesh,
-                                         static_info=ctx.static_info)
+                                         static_info=ctx.static_info,
+                                         fetch_names=getattr(
+                                             ctx, "fetch_names", ()))
             fctx.check_nan = getattr(ctx, "check_nan", False)
             for op in ops[:bwd_idx]:
                 _lower_op(fctx, op)

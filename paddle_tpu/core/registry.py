@@ -60,7 +60,7 @@ class LowerContext:
     """
 
     def __init__(self, env, rng_fn, is_test=False, executor=None, block=None,
-                 mesh=None, static_info=None):
+                 mesh=None, static_info=None, fetch_names=()):
         self.env = env
         self._rng_fn = rng_fn      # () -> fresh jax PRNG key
         self.is_test = is_test
@@ -70,6 +70,9 @@ class LowerContext:
         # trace-time constants derived from the feed (e.g. "<name>@MAXLEN"
         # bucketed max sequence length); part of the compile-cache key
         self.static_info = static_info or {}
+        # what the caller will fetch — rematerialization regions consult
+        # this so a fetched region output is exported instead of dropped
+        self.fetch_names = tuple(fetch_names or ())
 
     # -- value access --------------------------------------------------------
     def get(self, name):

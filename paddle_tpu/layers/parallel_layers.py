@@ -69,10 +69,13 @@ def sparse_moe(x, num_experts, d_inner, capacity_factor=1.25,
 
 
 def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
-                            num_microbatches=0, name=None):
+                            num_microbatches=0, recompute=False,
+                            name=None):
     """L identical causal decoder layers with layer-stacked parameters
     ([L, ...], leading dim sharded on the pp mesh axis → GPipe schedule
     under ParallelExecutor; lax.scan over layers otherwise).
+    recompute=True rematerializes each layer's activations in the
+    backward pass (jax.checkpoint on the scan body).
     x: [B, T, D]. Returns [B, T, D]."""
     helper = LayerHelper("pipeline_stack", name=name)
     d = int(x.shape[-1])
@@ -106,5 +109,6 @@ def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
         type="pipeline_stack",
         inputs=dict({"X": [x]}, **{s: [w] for s, w in params.items()}),
         outputs={"Out": [out]},
-        attrs={"n_head": n_head, "num_microbatches": num_microbatches})
+        attrs={"n_head": n_head, "num_microbatches": num_microbatches,
+               "recompute": bool(recompute)})
     return out

@@ -9,6 +9,8 @@ attention math as batched matmuls on the MXU; bf16-friendly. This is the
 flagship perf model (BASELINE.json north star: Transformer tokens/sec/chip).
 """
 
+import contextlib
+
 import numpy as np
 
 import paddle_tpu as fluid
@@ -193,7 +195,6 @@ def transformer_lm(vocab_size=4096, max_len=256, n_layer=4, n_head=8,
     if dropout_rate:
         x = layers.dropout(x, dropout_prob=dropout_rate)
     bias = None if packed else make_attn_bias(mask, n_head, causal=True)
-    import contextlib
     for _ in range(n_layer):
         with layers.recompute() if recompute else contextlib.nullcontext():
             x = decoder_layer(x, None, bias, None, n_head, d_key, d_value,

@@ -25,7 +25,8 @@ def _trace_block(ctx, block, env):
     from ..core.executor import _lower_op
     sctx = LowerContext(env, ctx._rng_fn, is_test=ctx.is_test,
                         executor=ctx.executor, block=block,
-                        static_info=ctx.static_info)
+                        static_info=ctx.static_info,
+                        fetch_names=getattr(ctx, "fetch_names", ()))
     for op2 in block.ops:
         _lower_op(sctx, op2)
     return env
@@ -174,10 +175,11 @@ def _recompute_block(ctx, op):
     through the region; RNG-consuming ops (dropout) reuse one region key,
     so the recompute replays identical masks.
 
-    Outputs exported from the region are the sub-block writes consumed by
-    LATER ops of the parent block (plus persistables); an intermediate
-    that is only fetched would defeat the remat, so it is not exported —
-    fetch it outside a recompute region instead."""
+    Outputs exported from the region are the sub-block writes consumed
+    by LATER ops of the parent block (looking through their sub-blocks),
+    persistables, and anything in the run's fetch list — an explicitly
+    fetched region value is materialized (the user asked to store it);
+    everything else is recomputed."""
     from ..core.executor import _lower_op, _NANGUARD
 
     block = op.attr("sub_block")
@@ -188,15 +190,30 @@ def _recompute_block(ctx, op):
         raise RuntimeError(
             "recompute_block op not found in its parent block's op list "
             "— the lowering must run on the block that owns the op")
-    # the layer records external reads/writes as real op inputs/outputs,
-    # so this scan sees through later recompute regions too
-    later_reads = {n for o in parent_ops[my_idx + 1:]
-                   for ns in o.inputs.values() for n in ns}
+    # names a later op may read: its declared inputs PLUS everything read
+    # inside any sub-block it carries (While/recurrent/IfElse bodies do
+    # not re-declare their body reads as parent-op inputs)
+    def op_reads(o, seen=None):
+        seen = set() if seen is None else seen
+        names = {n for ns in o.inputs.values() for n in ns}
+        for a in o.attrs.values():
+            blocks = a if isinstance(a, (list, tuple)) else [a]
+            for b in blocks:
+                if hasattr(b, "ops") and id(b) not in seen:
+                    seen.add(id(b))
+                    for o2 in b.ops:
+                        names |= op_reads(o2, seen)
+        return names
+
+    later_reads = set()
+    for o in parent_ops[my_idx + 1:]:
+        later_reads |= op_reads(o)
     persistable = {v.name for v in ctx.block.vars.values()
                    if getattr(v, "persistable", False)} \
         if ctx.block is not None else set()
+    fetches = set(getattr(ctx, "fetch_names", ()))
     out_names = [n for n in op.output("Out")
-                 if n in later_reads or n in persistable]
+                 if n in later_reads or n in persistable or n in fetches]
     in_names = [n for n in op.input("X") if n in ctx.env]
 
     base_env = dict(ctx.env)
@@ -214,7 +231,8 @@ def _recompute_block(ctx, op):
 
         sctx = LowerContext(env, rfn, is_test=ctx.is_test,
                             executor=ctx.executor, block=block,
-                            mesh=ctx.mesh, static_info=ctx.static_info)
+                            mesh=ctx.mesh, static_info=ctx.static_info,
+                            fetch_names=getattr(ctx, "fetch_names", ()))
         sctx.check_nan = getattr(ctx, "check_nan", False)
         sctx._nan_idx = guard_start   # program-order guard keys continue
         for op2 in block.ops:

@@ -15,6 +15,8 @@ mandate; the op-level integration mirrors how ParallelExecutor made DP a
 two-line change in the reference API.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -123,9 +125,14 @@ def _pipeline_stack(ctx, op):
     parameters (leading dim L). With a pp mesh axis of size S the stack
     runs as an S-stage GPipe (L/S layers per stage, activations on the ICI
     ring); otherwise as a lax.scan over layers. Attrs: n_head,
-    num_microbatches (0 = auto 2*S)."""
+    num_microbatches (0 = auto 2*S), recompute (jax.checkpoint per
+    layer — scan-over-layers + remat is the standard memory-efficient
+    deep stack)."""
     x = ctx.in1(op, "X")
     n_head = int(op.attr("n_head", 8))
+    layer_apply = functools.partial(_decoder_layer_apply, n_head=n_head)
+    if op.attr("recompute"):
+        layer_apply = jax.checkpoint(layer_apply)
     params = {key: ctx.in1(op, slot)
               for key, slot in zip(_STACK_KEYS, _STACK_SLOTS)}
     n_layer = params["wq"].shape[0]
@@ -133,7 +140,7 @@ def _pipeline_stack(ctx, op):
 
     if mesh is None:
         def body(carry, layer_p):
-            return _decoder_layer_apply(layer_p, carry, n_head), None
+            return layer_apply(layer_p, carry), None
 
         out, _ = lax.scan(body, x, params)
         ctx.set_out(op, "Out", out)
@@ -150,7 +157,7 @@ def _pipeline_stack(ctx, op):
 
     def stage_fn(stage_params, mb):
         def body(carry, layer_p):
-            return _decoder_layer_apply(layer_p, carry, n_head), None
+            return layer_apply(layer_p, carry), None
 
         out, _ = lax.scan(body, mb, stage_params)
         return out

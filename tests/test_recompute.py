@@ -174,6 +174,118 @@ def test_checkpoint_composes_with_accumulation():
         np.testing.assert_allclose(b[n], a[n], rtol=1e-5, atol=1e-6)
 
 
+def test_recompute_output_readable_by_while_body():
+    # a later control-flow op reads the region output only inside ITS
+    # sub-block — the export scan must look through sub-blocks
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", [4])
+        with fluid.layers.recompute():
+            h = fluid.layers.fc(x, 4, bias_attr=False,
+                                param_attr=fluid.ParamAttr(
+                                    name="w_whl",
+                                    initializer=fluid.initializer.Constant(
+                                        0.5)))
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        acc = fluid.layers.fill_constant([4, 4], "float32", 0.0)
+        n = fluid.layers.fill_constant([1], "int64", 3)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond, loop_vars=[i, acc])
+        with w.block():
+            acc2 = fluid.layers.elementwise_add(acc, h)   # h read in body
+            fluid.layers.assign(acc2, acc)
+            i2 = fluid.layers.increment(i)
+            fluid.layers.assign(fluid.layers.less_than(i2, n), cond)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.ones((4, 4), np.float32)
+        out, = exe.run(feed={"x": xv}, fetch_list=[acc])
+    np.testing.assert_allclose(np.asarray(out), 3 * (xv @ np.full(
+        (4, 4), 0.5, np.float32)), rtol=1e-6)
+
+
+def test_recompute_terminal_output_fetchable():
+    # a region output with no later consumer must still be fetchable
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", [4])
+        with fluid.layers.recompute():
+            h = fluid.layers.fc(x, 2, bias_attr=False,
+                                param_attr=fluid.ParamAttr(
+                                    name="w_tf",
+                                    initializer=fluid.initializer.Constant(
+                                        1.0)))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+        out, = exe.run(feed={"x": xv}, fetch_list=[h])
+    np.testing.assert_allclose(np.asarray(out), xv @ np.ones((4, 2),
+                                                             np.float32))
+
+
+def test_pipeline_stack_recompute_gpipe_mesh_parity():
+    # the GPipe branch (pp mesh) with recompute on: parity vs the same
+    # program without recompute on the same mesh
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu import parallel
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+
+    def run(recompute, prefix):
+        mesh = parallel.make_mesh({"dp": 2, "pp": 2})
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 17
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard(prefix):
+            x = fluid.layers.data("x", [8, 16])
+            y = fluid.layers.pipelined_decoder_stack(
+                x, n_layer=2, n_head=2, d_inner=32, recompute=recompute)
+            loss = fluid.layers.mean(fluid.layers.square(y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            pexe = fluid.ParallelExecutor(
+                loss_name=loss.name, main_program=main, mesh=mesh,
+                scope=scope)
+            xv = np.random.RandomState(4).rand(16, 8, 16).astype(
+                np.float32)
+            l, = pexe.run([loss], feed={"x": xv})
+        return float(np.asarray(l))
+
+    l0 = run(False, "gp_")
+    l1 = run(True, "gr_")
+    np.testing.assert_allclose(l1, l0, rtol=1e-5)
+
+
+def test_pipeline_stack_recompute_matches_plain():
+    def run(recompute, prefix):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 13
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard(prefix):
+            x = fluid.layers.data("x", [8, 16])
+            y = fluid.layers.pipelined_decoder_stack(
+                x, n_layer=2, n_head=2, d_inner=32, recompute=recompute)
+            loss = fluid.layers.mean(fluid.layers.square(y))
+            pg = fluid.append_backward(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            xv = np.random.RandomState(3).rand(2, 8, 16).astype(np.float32)
+            vals = exe.run(main, feed={"x": xv},
+                           fetch_list=[loss, pg[0][1].name])
+        return float(np.asarray(vals[0])), np.asarray(vals[1])
+
+    l0, g0 = run(False, "pp_")
+    l1, g1 = run(True, "pr_")
+    np.testing.assert_allclose(l1, l0, rtol=1e-5)
+    np.testing.assert_allclose(g1, g0, rtol=1e-4, atol=1e-6)
+
+
 def test_recompute_region_general_graph():
     # non-transformer usage: arbitrary ops in a region, grads through two
     # chained regions
