@@ -53,6 +53,8 @@ from .reader import batch  # noqa: F401
 from . import io  # noqa: F401
 from . import nets  # noqa: F401
 from . import metrics  # noqa: F401
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
 from . import recordio  # noqa: F401

@@ -1,0 +1,48 @@
+"""fluid.average.WeightedAverage + fluid.evaluator façade parity
+(reference python/paddle/fluid/average.py, evaluator.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_weighted_average():
+    wa = fluid.average.WeightedAverage()
+    wa.add(2.0, 1)
+    wa.add(4.0, 3)
+    assert abs(wa.eval() - 3.5) < 1e-9
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+    with pytest.raises(ValueError):
+        wa.add("nope", 1)
+
+
+def test_evaluator_aliases_are_metrics():
+    assert fluid.evaluator.ChunkEvaluator is fluid.metrics.ChunkEvaluator
+    assert fluid.evaluator.EditDistance is fluid.metrics.EditDistance
+
+
+def test_detection_map_evaluator():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        m = fluid.evaluator.DetectionMAP(det, gt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        # two perfect detections -> mAP 1.0
+        dv = np.array([[0, 0.9, 0, 0, 10, 10],
+                       [1, 0.8, 20, 20, 30, 30]], np.float32)
+        gv = np.array([[0, 0, 0, 10, 10],
+                       [1, 20, 20, 30, 30]], np.float32)
+        for _ in range(3):
+            mv, = exe.run(main, feed={"det": dv, "gt": gv},
+                          fetch_list=m.metrics)
+            m.update(mv)
+        out = m.eval()
+    np.testing.assert_allclose(out, [1.0], rtol=1e-5)
+    m.reset()
+    with pytest.raises(ValueError):
+        m.eval()
