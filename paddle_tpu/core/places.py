@@ -39,7 +39,7 @@ class Place:
         """Resolve to a concrete jax.Device (falls back to default device)."""
         devs = _devices_for_kind(self.device_kind)
         if not devs:
-            return jax.devices()[0]
+            return jax.local_devices()[0]
         return devs[self.device_id % len(devs)]
 
     def is_accelerator(self):
@@ -48,16 +48,36 @@ class Place:
 
 @functools.cache
 def _devices_for_kind(kind):
+    # LOCAL devices only: in a multi-process (multi-host) group,
+    # jax.devices() lists every process's devices and [0] would be rank
+    # 0's — a single-device executor on another rank would then commit
+    # state to a device it cannot address.
     if kind == "cpu":
+        # JAX_PLATFORMS=<accelerator-only> (the axon tunnel exports
+        # JAX_PLATFORMS=axon) drops the CPU backend, silently turning
+        # CPUPlace into the accelerator. Append "cpu" BEFORE the first
+        # backend query — the platform list freezes once backends
+        # initialize, so a post-failure retry would be too late. The
+        # accelerator stays first in the list: default placement is
+        # unchanged, only explicit CPUPlace resolves differently.
         try:
-            return tuple(jax.devices("cpu"))
+            plats = jax.config.jax_platforms
+            if plats and "cpu" not in plats.split(","):
+                jax.config.update("jax_platforms", plats + ",cpu")
+        except Exception:
+            pass
+        try:
+            # backend="cpu" queries the CPU backend explicitly — plain
+            # local_devices() lists only the DEFAULT backend, which on a
+            # TPU host would leave this empty and fall back to the TPU
+            return tuple(jax.local_devices(backend="cpu"))
         except RuntimeError:
             return ()
     if kind == "accel":
         # Whatever non-CPU platform is live (tpu under axon, else cpu).
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
-        return tuple(devs) if devs else tuple(jax.devices())
-    return tuple(jax.devices())
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+        return tuple(devs) if devs else tuple(jax.local_devices())
+    return tuple(jax.local_devices())
 
 
 class CPUPlace(Place):

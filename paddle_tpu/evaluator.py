@@ -27,6 +27,18 @@ class DetectionMAP:
         from . import layers
         from .layers.layer_helper import LayerHelper
 
+        # the detection_map lowering implements 11-point AP over all GT
+        # boxes; unsupported knobs are rejected loudly rather than
+        # silently computing a different metric (class_num is accepted —
+        # classes are derived from the label column)
+        if gt_difficult is not None or not evaluate_difficult:
+            raise NotImplementedError(
+                "DetectionMAP: difficult-GT filtering is not implemented "
+                "(gt_difficult must be None, evaluate_difficult True)")
+        if ap_version != "11point":
+            raise NotImplementedError(
+                "DetectionMAP: only ap_version='11point' is implemented")
+
         helper = LayerHelper("detection_map_eval")
         label = gt_label if gt_box is None else \
             layers.concat([gt_label, gt_box], axis=1)

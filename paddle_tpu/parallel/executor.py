@@ -147,14 +147,14 @@ class ParallelExecutor:
                 finally:
                     enable_amp(prev)
 
-            data_sh = self._data_sharding()
-            state_sh = {n: self._state_sharding(n) for n in state_keys}
-            in_shardings = (state_sh,
-                            {k: (repl if k in lod_keys else data_sh)
-                             for k in feed_arrays},
-                            repl)
-            entry = jax.jit(fn, in_shardings=in_shardings,
-                            donate_argnums=(0,))
+            # Shardings are established by COMMITTING the inputs (the
+            # device_put/make_array calls below), not by in_shardings:
+            # constraining the jit would force a reshard of step-2 state
+            # (whose committed sharding is whatever step 1 produced),
+            # which multi-process arrays cannot do. Committed-input
+            # propagation is the standard JAX training-loop pattern and
+            # keeps single- and multi-host behavior identical.
+            entry = jax.jit(fn, donate_argnums=(0,))
             self._cache[key] = entry
 
         rng_key = jax.random.key(
@@ -163,17 +163,60 @@ class ParallelExecutor:
         self._exe._rng_counter += 1
 
         # BCastParamsToGPUs parity: place state per its sharding once;
-        # jit keeps the placement on subsequent steps.
-        state_dev = {
-            n: (v if isinstance(v, jax.Array)
-                else jax.device_put(v, self._state_sharding(n)))
-            for n, v in state.items()}
+        # jit keeps the placement on subsequent steps. On a multi-process
+        # (multi-host) mesh, host values become GLOBAL arrays via
+        # make_array_from_callback — every process passes the same full
+        # array (the reference's same-data-every-trainer contract) and
+        # keeps only its addressable shards.
+        multiproc = jax.process_count() > 1
+
+        def to_global(v, sh):
+            if isinstance(v, jax.Array):
+                if not v.is_fully_addressable or v.sharding == sh:
+                    # steady-state pass-through: step outputs keep their
+                    # committed (GSPMD-chosen) layouts; a multi-process
+                    # array cannot be resharded host-side anyway
+                    return v
+                # addressable but mis-placed (e.g. single-device startup
+                # output vs a tp sharding hint): lay it out per the hint
+                if multiproc:
+                    v = np.asarray(v)
+                else:
+                    return jax.device_put(v, sh)
+            if multiproc:
+                arr = np.asarray(v)
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, _a=arr: _a[idx])
+            return jax.device_put(v, sh)
+
+        state_dev = {n: to_global(v, self._state_sharding(n))
+                     for n, v in state.items()}
         data_sh = self._data_sharding()
-        feeds_dev = {k: jax.device_put(v, repl if k in lod_keys else data_sh)
+        feeds_dev = {k: to_global(v, repl if k in lod_keys else data_sh)
                      for k, v in feed_arrays.items()}
 
         fetches, new_state, guards, fetch_lods = entry(
             state_dev, feeds_dev, rng_key)
+
+        def local_value(v):
+            # a replicated output's sharding spans remote devices; its
+            # local shard IS the value. A dp-SHARDED fetch has no local
+            # full value — fail loudly rather than hand back 1/N of the
+            # batch (fetch losses/metrics, which the step all-reduces).
+            if multiproc and isinstance(v, jax.Array) \
+                    and not v.is_fully_addressable:
+                if not v.sharding.is_fully_replicated:
+                    raise NotImplementedError(
+                        "fetching a cross-process SHARDED value (spec %s) "
+                        "is not supported — fetch replicated values "
+                        "(losses/metrics) or gather in-graph first"
+                        % (v.sharding.spec,))
+                return np.asarray(list(v.addressable_shards)[0].data)
+            return v
+
+        fetches = [local_value(v) for v in fetches]
+        fetch_lods = {k: local_value(v) for k, v in fetch_lods.items()}
+        guards = {k: local_value(v) for k, v in guards.items()}
         fetches = Executor._trim_fetches(fetch_names, fetches, fetch_lods)
         for n, v in new_state.items():
             scope.set(n, v)

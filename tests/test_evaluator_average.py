@@ -24,6 +24,17 @@ def test_evaluator_aliases_are_metrics():
     assert fluid.evaluator.EditDistance is fluid.metrics.EditDistance
 
 
+def test_detection_map_rejects_unsupported_knobs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        with pytest.raises(NotImplementedError, match="difficult"):
+            fluid.evaluator.DetectionMAP(det, gt, evaluate_difficult=False)
+        with pytest.raises(NotImplementedError, match="11point"):
+            fluid.evaluator.DetectionMAP(det, gt, ap_version="integral")
+
+
 def test_detection_map_evaluator():
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
