@@ -29,12 +29,14 @@ class DetectionMAP:
 
         # the detection_map lowering implements 11-point AP over all GT
         # boxes; unsupported knobs are rejected loudly rather than
-        # silently computing a different metric (class_num is accepted —
-        # classes are derived from the label column)
-        if gt_difficult is not None or not evaluate_difficult:
+        # silently computing a different metric. With the default
+        # evaluate_difficult=True difficult boxes count anyway, so a
+        # provided gt_difficult cannot change the result and is accepted
+        # (class_num likewise — classes come from the label column).
+        if not evaluate_difficult:
             raise NotImplementedError(
-                "DetectionMAP: difficult-GT filtering is not implemented "
-                "(gt_difficult must be None, evaluate_difficult True)")
+                "DetectionMAP: excluding difficult ground truth "
+                "(evaluate_difficult=False) is not implemented")
         if ap_version != "11point":
             raise NotImplementedError(
                 "DetectionMAP: only ap_version='11point' is implemented")

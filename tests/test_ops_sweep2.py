@@ -857,6 +857,8 @@ COVERED_ELSEWHERE = {
     # beam search — tests/test_beam_search.py
     "beam_search": "test_beam_search.py",
     "beam_search_decode": "test_beam_search.py",
+    # rematerialization regions — tests/test_recompute.py
+    "recompute_block": "test_recompute.py",
     # parallel/distributed subsystems — dedicated suites
     "sp_attention": "test_parallel_integration.py",
     "moe_ffn": "test_pipeline_moe.py",
