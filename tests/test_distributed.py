@@ -75,6 +75,33 @@ def test_variable_server_put_get_prefetch_barrier():
         dist_ops.reset_clients()
 
 
+def _probe_port():
+    """Grab an ephemeral port by briefly binding a VariableServer."""
+    probe = VariableServer()
+    port = probe.port
+    probe.stop()
+    return "127.0.0.1:%d" % port
+
+
+def _boot_pserver(pserver_prog, server_scope, lr=0.1):
+    """Shared pserver bootstrap: set the optimizer sub-block's
+    LearningRate var in the server scope and run listen_and_serv on a
+    daemon thread. Returns (thread, listen_and_serv op)."""
+    lanv = [op for op in pserver_prog.global_block().ops
+            if op.type == "listen_and_serv"][0]
+    lr_name = lanv.attr("optimize_blocks")[0].ops[0].input(
+        "LearningRate")[0]
+    server_scope.set(lr_name, np.asarray([lr], np.float32))
+
+    def run():
+        fluid.Executor(fluid.CPUPlace()).run(
+            pserver_prog, feed={}, fetch_list=[], scope=server_scope)
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th, lanv
+
+
 def _build_trainer(lr=0.1):
     x = fluid.layers.data("x", [4])
     y = fluid.layers.data("y", [1])
@@ -109,18 +136,7 @@ def test_pserver_mode_training_matches_local():
         t.transpile(trainer_id=0, program=main2,
                     pservers="127.0.0.1:0", trainers=1)
         # server on an ephemeral port: build program after picking a port
-        server_holder = {}
-
-        def run_server(pserver_prog, scope):
-            srv_exe = fluid.Executor(fluid.CPUPlace())
-            with fluid.scope_guard(scope):
-                srv_exe.run(pserver_prog, feed={}, fetch_list=[])
-
-        # pick a real port first via a probe server
-        probe = VariableServer()
-        port = probe.port
-        probe.stop()
-        ep = "127.0.0.1:%d" % port
+        ep = _probe_port()
         t._eps = [ep]
         # rewrite trainer endpoints
         for op in main2.global_block().ops:
@@ -134,16 +150,7 @@ def test_pserver_mode_training_matches_local():
         with fluid.scope_guard(scope2):
             exe2.run(startup2)
         server_scope.set("w_dist", np.zeros((4, 1), np.float32))
-        lanv = [op for op in pserver_prog.global_block().ops
-                if op.type == "listen_and_serv"][0]
-        opt_blk = lanv.attr("optimize_blocks")[0]
-        lr_name = opt_blk.ops[0].input("LearningRate")[0]
-        server_scope.set(lr_name, np.asarray([0.1], np.float32))
-
-        th = threading.Thread(target=run_server,
-                              args=(pserver_prog, server_scope),
-                              daemon=True)
-        th.start()
+        th, _ = _boot_pserver(pserver_prog, server_scope)
         time.sleep(0.5)
 
         try:
@@ -212,10 +219,7 @@ def test_async_pserver_training_reaches_local_loss():
         t = fluid.DistributeTranspiler(mode="pserver")
         t.transpile(trainer_id=0, program=main2, pservers="127.0.0.1:0",
                     trainers=1, sync_mode=False)
-        probe = VariableServer()
-        port = probe.port
-        probe.stop()
-        ep = "127.0.0.1:%d" % port
+        ep = _probe_port()
         t._eps = [ep]
         for op in main2.global_block().ops:
             if op.type in ("send", "recv"):
@@ -227,22 +231,8 @@ def test_async_pserver_training_reaches_local_loss():
         with fluid.scope_guard(scope2):
             exe2.run(startup2)
         server_scope.set("w_dist", np.zeros((4, 1), np.float32))
-        lanv = [op for op in pserver_prog.global_block().ops
-                if op.type == "listen_and_serv"][0]
+        th, lanv = _boot_pserver(pserver_prog, server_scope)
         assert lanv.attr("sync_mode") is False
-        opt_blk = lanv.attr("optimize_blocks")[0]
-        lr_name = opt_blk.ops[0].input("LearningRate")[0]
-        server_scope.set(lr_name, np.asarray([0.1], np.float32))
-
-        def run_server(pserver_prog, scope):
-            srv_exe = fluid.Executor(fluid.CPUPlace())
-            with fluid.scope_guard(scope):
-                srv_exe.run(pserver_prog, feed={}, fetch_list=[])
-
-        th = threading.Thread(target=run_server,
-                              args=(pserver_prog, server_scope),
-                              daemon=True)
-        th.start()
         time.sleep(0.5)
         try:
             for _ in range(5):
@@ -257,6 +247,79 @@ def test_async_pserver_training_reaches_local_loss():
         th.join(timeout=5)
 
     np.testing.assert_allclose(w_dist, w_local, rtol=1e-4, atol=1e-5)
+
+
+def test_two_pserver_training_matches_local():
+    """Round-robin param placement across TWO pservers
+    (distributed_splitter parity): a 2-param model trains to the same
+    weights as local SGD with each server owning one param."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4).astype(np.float32)
+    yv = (xv @ np.array([1., 2., 3., 4.], np.float32))[:, None] + 0.5
+
+    def build():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(
+            x, 1,
+            param_attr=fluid.ParamAttr(
+                name="w2p", initializer=fluid.initializer.Constant(0.)),
+            bias_attr=fluid.ParamAttr(
+                name="b2p", initializer=fluid.initializer.Constant(0.)))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return loss
+
+    # local baseline
+    main1, startup1 = fluid.Program(), fluid.Program()
+    scope1 = fluid.Scope()
+    with fluid.program_guard(main1, startup1), fluid.scope_guard(scope1):
+        loss1 = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup1)
+        for _ in range(5):
+            exe.run(main1, feed={"x": xv, "y": yv}, fetch_list=[loss1])
+        w_ref = np.asarray(scope1.find_var("w2p")).copy()
+        b_ref = np.asarray(scope1.find_var("b2p")).copy()
+
+    # distributed: 1 trainer, 2 pservers (one param each)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = fluid.Scope()
+    with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2):
+        loss2 = build()
+        eps = [_probe_port(), _probe_port()]
+        t = fluid.DistributeTranspiler(mode="pserver")
+        t.transpile(trainer_id=0, program=main2, pservers=",".join(eps),
+                    trainers=1, startup_program=startup2)
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        threads = []
+        for ep in eps:
+            sprog = t.get_pserver_program(ep)
+            sscope = fluid.Scope()
+            with fluid.scope_guard(sscope):
+                fluid.Executor(fluid.CPUPlace()).run(
+                    t.get_startup_program(ep))
+            th, _ = _boot_pserver(sprog, sscope)
+            threads.append(th)
+        time.sleep(0.5)
+        try:
+            for _ in range(5):
+                exe2.run(main2, feed={"x": xv, "y": yv},
+                         fetch_list=[loss2], scope=scope2)
+            w_dist = np.asarray(scope2.find_var("w2p")).copy()
+            b_dist = np.asarray(scope2.find_var("b2p")).copy()
+        finally:
+            for ep in eps:
+                cli = RPCClient(ep)
+                cli.shutdown_server()
+                cli.close()
+            dist_ops.reset_clients()
+        for th in threads:
+            th.join(timeout=5)
+
+    np.testing.assert_allclose(w_dist, w_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(b_dist, b_ref, rtol=1e-4, atol=1e-5)
 
 
 def test_pserver_startup_program_initializes_owned_params():
