@@ -1,0 +1,123 @@
+"""Kill-and-resume for MESH-mode training (complements test_elastic.py's
+pserver/master tier): a trainer process checkpoints every step
+(io.save_checkpoint: atomic npz + CRC meta), is SIGKILLed mid-run, and a
+fresh process resumes from the newest valid checkpoint — final weights
+must exactly match an uninterrupted run, proving optimizer accumulators
+(Adam moments) round-trip through the checkpoint too.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_WORKER = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import parallel
+from paddle_tpu.core import unique_name
+
+ckdir, steps, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+with unique_name.guard("mr_"):
+    x = fluid.layers.data("x", [6])
+    y = fluid.layers.data("y", [1])
+    h = fluid.layers.fc(x, 12, act="tanh")
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+main = fluid.default_main_program()
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+start = 0
+resumed = fluid.io.load_checkpoint(ckdir, main_program=main)
+if resumed is not None:
+    start = resumed + 1
+    print("resumed from step", resumed, flush=True)
+pexe = fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                              mesh=parallel.make_mesh({"dp": 4}))
+rng = np.random.RandomState(7)
+batches = [(rng.rand(8, 6).astype(np.float32),
+            rng.rand(8, 1).astype(np.float32)) for _ in range(steps)]
+for i in range(start, steps):
+    xv, yv = batches[i]
+    l, = pexe.run([loss], feed={"x": xv, "y": yv})
+    fluid.io.save_checkpoint(ckdir, i, main_program=main)
+    print("step %%d loss %%.6f" %% (i, float(np.asarray(l))), flush=True)
+    if mode == "crash" and i == 2:
+        import time
+        time.sleep(600)   # parent SIGKILLs us here, mid-run
+ws = {v.name: np.asarray(fluid.global_scope().find_var(v.name))
+      for v in main.global_block().vars.values()
+      if v.persistable and fluid.global_scope().find_var(v.name)
+      is not None}
+np.savez(ckdir + "/final_%%s.npz" %% mode, **ws)
+print("DONE", flush=True)
+"""
+
+
+def _spawn(script, ckdir, steps, mode):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    return subprocess.Popen(
+        [sys.executable, str(script), str(ckdir), str(steps), mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def test_mesh_training_kill_and_resume(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER % {"repo": repo})
+    steps = 6
+
+    # uninterrupted baseline
+    base_dir = tmp_path / "base"
+    base_dir.mkdir()
+    p = _spawn(script, base_dir, steps, "plain")
+    out, _ = p.communicate(timeout=240)
+    assert p.returncode == 0 and "DONE" in out, out[-2000:]
+
+    # crashing run: SIGKILL while the worker sleeps after step 2
+    crash_dir = tmp_path / "crash"
+    crash_dir.mkdir()
+    p = _spawn(script, crash_dir, steps, "crash")
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if (crash_dir / "meta-2.json").exists():
+            break
+        if p.poll() is not None:     # died early: surface its traceback
+            out, _ = p.communicate(timeout=10)
+            raise AssertionError(
+                "crash worker exited rc=%s before step 2:\n%s"
+                % (p.returncode, out[-2000:]))
+        time.sleep(0.5)
+    else:
+        p.kill()
+        out, _ = p.communicate(timeout=10)
+        raise AssertionError(
+            "crash worker never reached step 2:\n%s" % out[-2000:])
+    time.sleep(0.5)
+    p.send_signal(signal.SIGKILL)
+    p.wait(timeout=30)
+
+    # resume in a FRESH process; must pick up from step 3
+    p = _spawn(script, crash_dir, steps, "resume")
+    out, _ = p.communicate(timeout=240)
+    assert p.returncode == 0 and "DONE" in out, out[-2000:]
+    assert "resumed from step 2" in out, out[-2000:]
+
+    base = np.load(base_dir / "final_plain.npz")
+    res = np.load(crash_dir / "final_resume.npz")
+    assert sorted(base.files) == sorted(res.files)
+    for n in base.files:
+        # bitwise: the same jitted step on identical float32 inputs is
+        # deterministic, so resume must reproduce the baseline exactly
+        np.testing.assert_array_equal(res[n], base[n], err_msg=n)
