@@ -24,6 +24,7 @@ from .parallel_layers import (  # noqa: F401
     pipelined_decoder_stack, sequence_parallel_attention, sparse_moe,
 )
 from .sequence_layers import *  # noqa: F401,F403
+from .compat import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from . import control_flow  # noqa: F401
 from .rnn_layers import *  # noqa: F401,F403

@@ -1,0 +1,356 @@
+"""Reference-parity layer wrappers over already-registered op lowerings.
+
+Every function here mirrors a `fluid.layers.*` entry of the reference
+whose OP already has a TPU lowering but which previously lacked the thin
+Python wrapper (reference layers/nn.py, tensor.py, detection.py,
+metric.py, ops.py). No new compute — just the user-facing API.
+"""
+
+from .layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "Print", "mul", "sums", "sum", "pad", "multiplex", "smooth_l1",
+    "lrn", "im2sequence", "uniform_random", "gaussian_random",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "nce", "warpctc", "ctc_greedy_decoder", "edit_distance", "chunk_eval",
+    "beam_search", "beam_search_decode", "bipartite_match",
+    "target_assign", "prior_box", "box_coder", "multiclass_nms",
+    "detection_output", "detection_map", "create_parameter",
+    "autoincreased_step_counter", "shrink_memory",
+    "reorder_lod_tensor_by_rank",
+]
+
+
+def _simple(op_type, inputs, attrs, out_slots=("Out",), dtype="float32",
+            name=None):
+    helper = LayerHelper(op_type, name=name)
+    outs = {s: [helper.create_variable_for_type_inference(dtype)]
+            for s in out_slots}
+    helper.append_op(type=op_type, inputs=inputs, outputs=outs,
+                     attrs=attrs or {})
+    vals = tuple(outs[s][0] for s in out_slots)
+    return vals if len(vals) > 1 else vals[0]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    return _simple("print", {"In": [input]},
+                   {"message": message or "", "first_n": first_n,
+                    "summarize": summarize}, dtype=input.dtype)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    shape = None
+    if x.shape is not None and y.shape is not None:
+        shape = (tuple(x.shape[:x_num_col_dims])
+                 + tuple(y.shape[y_num_col_dims:]))
+    out = helper.create_variable_for_type_inference(x.dtype, shape=shape)
+    helper.append_op(type="mul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+from .tensor import sums          # noqa: F401  (single implementation)
+
+sum = sums   # reference ops.py exported `sum` for the same op
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _simple("pad", {"X": [x]},
+                   {"paddings": list(paddings), "pad_value": pad_value},
+                   dtype=x.dtype, name=name)
+
+
+def multiplex(inputs, index):
+    return _simple("multiplex", {"X": list(inputs), "Ids": [index]}, {},
+                   dtype=inputs[0].dtype)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    out, _ = _simple("smooth_l1_loss", inputs,
+                     {"sigma": 1.0 if sigma is None else float(sigma)},
+                     out_slots=("Out", "Diff"), dtype=x.dtype)
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    return _simple("lrn", {"X": [input]},
+                   {"n": n, "k": k, "alpha": alpha, "beta": beta},
+                   dtype=input.dtype, name=name)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    pad4 = _pair(padding)
+    if len(pad4) == 2:
+        pad4 = pad4 + pad4
+    return _simple("im2sequence", {"X": [input]},
+                   {"kernels": _pair(filter_size),
+                    "strides": _pair(stride), "paddings": pad4},
+                   dtype=input.dtype, name=name)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    return _simple("uniform_random", {},
+                   {"shape": list(shape), "min": float(min),
+                    "max": float(max), "seed": seed, "dtype": dtype},
+                   dtype=dtype)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    return _simple("gaussian_random", {},
+                   {"shape": list(shape), "mean": float(mean),
+                    "std": float(std), "seed": seed, "dtype": dtype},
+                   dtype=dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    return _simple("uniform_random_batch_size_like", {"Input": [input]},
+                   {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                    "output_dim_idx": output_dim_idx, "min": float(min),
+                    "max": float(max), "seed": seed, "dtype": dtype},
+                   dtype=dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    return _simple("gaussian_random_batch_size_like", {"Input": [input]},
+                   {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                    "output_dim_idx": output_dim_idx, "mean": float(mean),
+                    "std": float(std), "seed": seed, "dtype": dtype},
+                   dtype=dtype)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None):
+    if sample_weight is not None:
+        raise NotImplementedError("nce: sample_weight is not implemented")
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
+    if input.shape is None or len(input.shape) < 2:
+        raise ValueError(
+            "nce: `input` must carry a known [batch, dim] shape; got %r"
+            % (input.shape,))
+    dim = int(input.shape[1])
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype)
+    sll = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="nce",
+        inputs={"Input": [input], "Label": [label], "Weight": [w],
+                "Bias": [b]},
+        outputs={"Cost": [cost], "SampleLogits": [sl],
+                 "SampleLabels": [sll]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples or 10})
+    return cost
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    loss, _ = _simple("warpctc", {"Logits": [input], "Label": [label]},
+                      {"blank": blank, "norm_by_times": norm_by_times},
+                      out_slots=("Loss", "WarpCTCGrad"),
+                      dtype=input.dtype)
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax over classes then CTC collapse (ctc_align), matching the
+    reference's topk+ctc_align composition."""
+    from .tensor import argmax
+    ids = argmax(input, axis=1)
+    return _simple("ctc_align", {"Input": [ids]},
+                   {"blank": blank, "merge_repeated": True},
+                   out_slots=("Output",), dtype="int64", name=name)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    if ignored_tokens:
+        # reference composition (layers/metric wrappers): erase the
+        # ignored tokens from both sequences BEFORE the distance
+        from .sequence_layers import sequence_erase
+        input = sequence_erase(input, list(ignored_tokens))
+        label = sequence_erase(label, list(ignored_tokens))
+    out, seq_num = _simple(
+        "edit_distance", {"Hyps": [input], "Refs": [label]},
+        {"normalized": normalized},
+        out_slots=("Out", "SequenceNum"))
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    outs = _simple(
+        "chunk_eval", {"Inference": [input], "Label": [label]},
+        {"chunk_scheme": chunk_scheme, "num_chunk_types": num_chunk_types,
+         "excluded_chunk_types": list(excluded_chunk_types or [])},
+        out_slots=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"))
+    return outs
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None):
+    """One beam-search step (beam_search_op.cc). `ids` is accepted for
+    API parity; selection uses `scores` ([rows, vocab] accumulated
+    log-probs when is_accumulated)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel = helper.create_variable_for_type_inference("int64")
+    ssc = helper.create_variable_for_type_inference(scores.dtype)
+    par = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "scores": [scores]},
+        outputs={"selected_ids": [sel], "selected_scores": [ssc],
+                 "parent_idx": [par]},
+        attrs={"beam_size": beam_size, "end_id": end_id,
+               "is_accumulated": is_accumulated})
+    return sel, ssc, par
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    helper = LayerHelper("beam_search_decode", name=name)
+    out_ids = helper.create_variable_for_type_inference("int64")
+    out_scores = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores]},
+        outputs={"SentenceIds": [out_ids],
+                 "SentenceScores": [out_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id})
+    return out_ids, out_scores
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    idx, dist = _simple(
+        "bipartite_match", {"DistMat": [dist_matrix]},
+        {"match_type": match_type or "bipartite",
+         "dist_threshold": dist_threshold or 0.5},
+        out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"))
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    out, w = _simple("target_assign", inputs,
+                     {"mismatch_value": float(mismatch_value)},
+                     out_slots=("Out", "OutWeight"), name=name)
+    return out, w
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=None, offset=0.5, name=None):
+    boxes, vars_ = _simple(
+        "prior_box", {"Input": [input], "Image": [image]},
+        {"min_sizes": list(min_sizes), "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios or [1.0]),
+         "variances": list(variance), "flip": flip, "clip": clip,
+         "step_w": (steps or [0.0, 0.0])[0],
+         "step_h": (steps or [0.0, 0.0])[1], "offset": offset},
+        out_slots=("Boxes", "Variances"), name=name)
+    return boxes, vars_
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    return _simple("box_coder",
+                   {"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                    "TargetBox": [target_box]},
+                   {"code_type": code_type,
+                    "box_normalized": box_normalized},
+                   out_slots=("OutputBox",), name=name)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
+                   keep_top_k=200, nms_threshold=0.3, normalized=True,
+                   background_label=0, name=None):
+    return _simple("multiclass_nms",
+                   {"BBoxes": [bboxes], "Scores": [scores]},
+                   {"score_threshold": score_threshold,
+                    "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                    "nms_threshold": nms_threshold,
+                    "normalized": normalized,
+                    "background_label": background_label}, name=name)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01,
+                     nms_eta=1.0):
+    if nms_eta != 1.0:
+        raise NotImplementedError(
+            "detection_output: adaptive nms_eta != 1.0 is not implemented")
+    """Reference composition (layers/detection.py detection_output):
+    decode predicted offsets against priors, then multiclass NMS."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    return multiclass_nms(decoded, scores,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def detection_map(detect_res, label, class_num=None, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="11point"):
+    m, _ = _simple("detection_map",
+                   {"DetectRes": [detect_res], "Label": [label]},
+                   {"overlap_threshold": overlap_threshold,
+                    "ap_version": ap_version,
+                    "background_label": background_label},
+                   out_slots=("MAP", "AccumPosCount"))
+    return m
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    helper = LayerHelper("create_parameter")
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, list(shape), dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    from .learning_rate_scheduler import _decay_step_counter
+    assert step == 1, "only step=1 counters are emitted"
+    return _decay_step_counter(begin=begin)
+
+
+def shrink_memory(x, i, table):
+    return _simple("shrink_rnn_memory", {"X": [x]}, {}, dtype=x.dtype)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    return _simple("reorder_lod_tensor_by_rank",
+                   {"X": [x], "RankTable": [rank_table]}, {},
+                   dtype=x.dtype)

@@ -237,6 +237,7 @@ def _chunk_eval(ctx, op):
     lens = ctx.maybe_get(op.input("Inference")[0] + "@LOD")
     num_types = int(op.attr("num_chunk_types", 1))
     scheme = op.attr("chunk_scheme", "IOB")
+    excluded = [int(e) for e in (op.attr("excluded_chunk_types") or [])]
     t = inf.shape[0]
     if lens is None:
         lens = jnp.asarray([t], jnp.int32)
@@ -251,19 +252,27 @@ def _chunk_eval(ctx, op):
     inf = jnp.where(valid, inf, sentinel)
     lab = jnp.where(valid, lab, sentinel)
 
+    def _exclude(start, typ):
+        # excluded chunk types do not count as chunks (chunk_eval_op.h
+        # isExcludedChunkType): their positions become non-chunk
+        for et in excluded:
+            start = start & (typ != et)
+            typ = jnp.where(typ == et, -1, typ)
+        return start, typ
+
     def chunk_starts(tags):
         if scheme == "plain":
             typ = tags
             prev = jnp.where(pos > 0, jnp.roll(tags, 1), -1)
             start = (typ >= 0) & (typ < num_types) & (typ != prev)
-            return start, typ
+            return _exclude(start, typ)
         # IOB: B tag starts; I starts a chunk if prev is different type/O
         is_b = (tags % 2 == 0) & (tags < 2 * num_types)
         is_i = (tags % 2 == 1) & (tags < 2 * num_types)
         typ = jnp.where(is_b | is_i, tags // 2, -1)
         prev_typ = jnp.where(pos > 0, jnp.roll(typ, 1), -2)
         start = is_b | (is_i & (typ != prev_typ))
-        return start, typ
+        return _exclude(start, typ)
 
     # a label chunk is correct iff an inference chunk has the SAME start,
     # SAME end, and SAME type (chunk_eval_op.h exact-span semantics)

@@ -71,17 +71,19 @@ def _prior_box(ctx, op):
     ctx.set_out(op, "Variances", jnp.asarray(var))
 
 
-def _iou_matrix(a, b):
-    """a [N,4], b [M,4] → [N,M] IoU (xmin,ymin,xmax,ymax)."""
-    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
-        jnp.maximum(a[:, 3] - a[:, 1], 0)
-    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
-        jnp.maximum(b[:, 3] - b[:, 1], 0)
+def _iou_matrix(a, b, offset=0.0):
+    """a [N,4], b [M,4] → [N,M] IoU (xmin,ymin,xmax,ymax). offset=1.0 for
+    PIXEL (normalized=False) box conventions — widths count both edges."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + offset, 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1] + offset, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + offset, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + offset, 0)
     ix0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
     iy0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
     ix1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
     iy1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
-    inter = jnp.maximum(ix1 - ix0, 0) * jnp.maximum(iy1 - iy0, 0)
+    inter = jnp.maximum(ix1 - ix0 + offset, 0) * \
+        jnp.maximum(iy1 - iy0 + offset, 0)
     union = area_a[:, None] + area_b[None, :] - inter
     return jnp.where(union > 0, inter / union, 0.0)
 
@@ -211,12 +213,13 @@ def _mine_hard_examples(ctx, op):
                 jnp.where(neg_mask, -1, match))
 
 
-def _nms_single_class(boxes, scores, score_thresh, nms_thresh, top_k):
+def _nms_single_class(boxes, scores, score_thresh, nms_thresh, top_k,
+                      offset=0.0):
     """boxes [M,4], scores [M] → keep mask [M] after greedy NMS."""
     m = boxes.shape[0]
     valid = scores > score_thresh
     order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
-    iou = _iou_matrix(boxes, boxes)
+    iou = _iou_matrix(boxes, boxes, offset)
 
     def body(i, keep):
         cand = order[i]
@@ -243,6 +246,7 @@ def _multiclass_nms(ctx, op):
     nms_top_k = int(op.attr("nms_top_k", -1))
     keep_top_k = int(op.attr("keep_top_k", 100))
     background = int(op.attr("background_label", 0))
+    offset = 0.0 if op.attr("normalized", True) else 1.0
 
     def per_image(b, s):
         c, m = s.shape
@@ -251,7 +255,7 @@ def _multiclass_nms(ctx, op):
             if cls == background:
                 continue
             keep = _nms_single_class(b, s[cls], score_thresh, nms_thresh,
-                                     nms_top_k)
+                                     nms_top_k, offset)
             sc = jnp.where(keep, s[cls], -1.0)
             lbl = jnp.full((m,), cls, jnp.float32)
             outs.append(jnp.concatenate(

@@ -1,0 +1,212 @@
+"""Reference-parity layer wrappers (layers/compat.py): every wrapper
+drives its op end-to-end through a user-style program."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=list(fetches))
+
+
+def test_mul_pad_sum_multiplex():
+    x = fluid.layers.data("x", [3])
+    y = fluid.layers.data("y", [3, 2], append_batch_size=False)
+    m = fluid.layers.mul(x, y)
+    p = fluid.layers.pad(x, [0, 0, 1, 1], pad_value=9.0)
+    s = fluid.layers.sums([x, x])
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    yv = np.ones((3, 2), np.float32)
+    mv, pv, sv = _run([m, p, s], {"x": xv, "y": yv})
+    np.testing.assert_allclose(np.asarray(mv), xv @ yv)
+    assert np.asarray(pv).shape == (2, 5)
+    np.testing.assert_allclose(np.asarray(pv)[:, 0], 9.0)
+    np.testing.assert_allclose(np.asarray(sv), 2 * xv)
+
+
+def test_random_and_batch_size_like():
+    u = fluid.layers.uniform_random([2000], min=-2.0, max=2.0)
+    g = fluid.layers.gaussian_random([2000], mean=1.0, std=2.0)
+    x = fluid.layers.data("x", [4])
+    ub = fluid.layers.uniform_random_batch_size_like(x, [-1, 7])
+    uv, gv, ubv = _run([u, g, ub], {"x": np.zeros((5, 4), np.float32)})
+    assert -2.0 <= float(np.asarray(uv).min()) and \
+        float(np.asarray(uv).max()) <= 2.0
+    assert abs(float(np.asarray(gv).mean()) - 1.0) < 0.3
+    assert np.asarray(ubv).shape == (5, 7)
+
+
+def test_smooth_l1_and_lrn():
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.data("y", [4])
+    l = fluid.layers.smooth_l1(x, y)
+    img = fluid.layers.data("img", [4, 6, 6])
+    n = fluid.layers.lrn(img)
+    lv, nv = _run([l, n], {"x": np.zeros((2, 4), np.float32),
+                           "y": np.ones((2, 4), np.float32),
+                           "img": np.ones((2, 4, 6, 6), np.float32)})
+    np.testing.assert_allclose(np.asarray(lv).reshape(-1), 2.0, rtol=1e-5)
+    assert np.asarray(nv).shape == (2, 4, 6, 6)
+
+
+def test_im2sequence_and_mulplex():
+    img = fluid.layers.data("img", [1, 4, 4])
+    seq = fluid.layers.im2sequence(img, filter_size=2, stride=2)
+    a = fluid.layers.data("a", [2])
+    b = fluid.layers.data("b", [2])
+    idx = fluid.layers.data("idx", [1], dtype="int32")
+    mx = fluid.layers.multiplex([a, b], idx)
+    sv, mv = _run([seq, mx], {
+        "img": np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4),
+        "a": np.zeros((2, 2), np.float32),
+        "b": np.ones((2, 2), np.float32),
+        "idx": np.array([[0], [1]], np.int32)})
+    assert np.asarray(sv).shape == (4, 4)
+    np.testing.assert_allclose(np.asarray(mv), [[0, 0], [1, 1]])
+
+
+def test_warpctc_and_greedy_decoder():
+    lg = fluid.layers.data("lg", [5], lod_level=1)
+    lb = fluid.layers.data("lb", [1], dtype="int64", lod_level=1)
+    loss = fluid.layers.warpctc(lg, lb, blank=0)
+    probs = fluid.layers.data("probs", [3], dtype="float32", lod_level=1)
+    dec = fluid.layers.ctc_greedy_decoder(probs, blank=0)
+    rng = np.random.RandomState(0)
+    logits = rng.rand(6, 5).astype(np.float32)
+    labels = np.array([[1], [2]], np.int64)
+    pv = np.array([[0.1, 0.8, 0.1], [0.1, 0.8, 0.1], [0.8, 0.1, 0.1],
+                   [0.1, 0.1, 0.8]], np.float32)
+    lv, dv = _run([loss, dec], {
+        "lg": fluid.create_lod_tensor(logits, [[6]]),
+        "lb": fluid.create_lod_tensor(labels, [[2]]),
+        "probs": fluid.create_lod_tensor(pv, [[4]])})
+    assert np.isfinite(np.asarray(lv)).all()
+    np.testing.assert_array_equal(np.asarray(dv).reshape(-1)[:2], [1, 2])
+
+
+def test_edit_distance_chunk_eval():
+    h = fluid.layers.data("h", [1], dtype="int64", lod_level=1)
+    r = fluid.layers.data("r", [1], dtype="int64", lod_level=1)
+    d, n = fluid.layers.edit_distance(h, r, normalized=False)
+    iv = fluid.layers.data("iv", [1], dtype="int64", lod_level=1)
+    lv = fluid.layers.data("lv", [1], dtype="int64", lod_level=1)
+    outs = fluid.layers.chunk_eval(iv, lv, "IOB", 1)
+    seq = np.array([[1], [2], [3]], np.int64)
+    ref = np.array([[1], [3]], np.int64)
+    lab = np.array([[0], [1], [2]], np.int64)
+    vals = _run([d, n, outs[3], outs[4]], {
+        "h": fluid.create_lod_tensor(seq, [[3]]),
+        "r": fluid.create_lod_tensor(ref, [[2]]),
+        "iv": fluid.create_lod_tensor(lab, [[3]]),
+        "lv": fluid.create_lod_tensor(lab, [[3]])})
+    assert int(np.asarray(vals[0]).reshape(-1)[0]) == 1   # one insertion
+    assert int(np.asarray(vals[2]).reshape(-1)[0]) == 1   # one chunk each
+    assert int(np.asarray(vals[3]).reshape(-1)[0]) == 1
+
+
+def test_edit_distance_ignored_tokens_and_chunk_exclusion():
+    h = fluid.layers.data("h", [1], dtype="int64", lod_level=1)
+    r = fluid.layers.data("r", [1], dtype="int64", lod_level=1)
+    d, _ = fluid.layers.edit_distance(h, r, normalized=False,
+                                      ignored_tokens=[0])
+    iv = fluid.layers.data("iv", [1], dtype="int64", lod_level=1)
+    lv = fluid.layers.data("lv", [1], dtype="int64", lod_level=1)
+    # IOB, 2 types; exclude type 0: only the type-1 chunk counts
+    outs = fluid.layers.chunk_eval(iv, lv, "IOB", 2,
+                                   excluded_chunk_types=[0])
+    seq = np.array([[0], [1], [0], [2]], np.int64)   # 0s ignored -> [1,2]
+    ref = np.array([[1], [2]], np.int64)
+    lab = np.array([[0], [1], [2], [3]], np.int64)   # B0 I0 B1 I1
+    vals = _run([d, outs[3], outs[4]], {
+        "h": fluid.create_lod_tensor(seq, [[4]]),
+        "r": fluid.create_lod_tensor(ref, [[2]]),
+        "iv": fluid.create_lod_tensor(lab, [[4]]),
+        "lv": fluid.create_lod_tensor(lab, [[4]])})
+    assert int(np.asarray(vals[0]).reshape(-1)[0]) == 0   # identical
+    assert int(np.asarray(vals[1]).reshape(-1)[0]) == 1   # type-0 excluded
+    assert int(np.asarray(vals[2]).reshape(-1)[0]) == 1
+
+
+def test_multiclass_nms_pixel_convention():
+    # two 1-pixel-overlap boxes: IoU differs between normalized (area
+    # w*h) and pixel (w+1)*(h+1) conventions; with normalized=False the
+    # +1 offset pushes IoU over the threshold and suppresses box 2
+    b = fluid.layers.data("b", [2, 4], append_batch_size=False)
+    sc = fluid.layers.data("s", [1, 2, 2], append_batch_size=False)
+    # normalized IoU = 3/15 = 0.20; pixel IoU = 8/24 = 0.33 — a 0.25
+    # threshold separates the conventions
+    keep_n = fluid.layers.multiclass_nms(b, sc, nms_threshold=0.25,
+                                         background_label=-1, keep_top_k=4)
+    keep_p = fluid.layers.multiclass_nms(b, sc, nms_threshold=0.25,
+                                         background_label=-1, keep_top_k=4,
+                                         normalized=False)
+    boxes = np.array([[0, 0, 3, 3], [2, 0, 5, 3]], np.float32)
+    scores = np.array([[[0.9, 0.8], [0.9, 0.8]]], np.float32)
+    nv, pv = _run([keep_n, keep_p], {"b": boxes[None], "s": scores})
+    n_kept = int((np.asarray(nv).reshape(-1, 6)[:, 1] > 0).sum())
+    p_kept = int((np.asarray(pv).reshape(-1, 6)[:, 1] > 0).sum())
+    assert n_kept == 4   # both boxes survive in both classes
+    assert p_kept == 2   # pixel convention suppresses the second box
+
+
+def test_detection_wrappers():
+    feat = fluid.layers.data("feat", [2, 3, 3])
+    img = fluid.layers.data("img", [3, 12, 12])
+    boxes, variances = fluid.layers.prior_box(
+        feat, img, min_sizes=[4.0], aspect_ratios=[1.0])
+    dist = fluid.layers.data("dist", [3, 3], append_batch_size=False)
+    midx, mdist = fluid.layers.bipartite_match(dist)
+    x = fluid.layers.data("xx", [3, 4])
+    tout, tw = fluid.layers.target_assign(x, midx)
+    dv = np.array([[0.9, 0.1, 0.2], [0.1, 0.8, 0.3], [0.2, 0.1, 0.7]],
+                  np.float32)
+    vals = _run([boxes, midx, tout], {
+        "feat": np.ones((1, 2, 3, 3), np.float32),
+        "img": np.ones((1, 3, 12, 12), np.float32),
+        "dist": dv,
+        "xx": np.ones((1, 3, 4), np.float32)})
+    assert np.asarray(vals[0]).shape[-1] == 4
+    assert np.asarray(vals[1]).shape == (1, 3)
+    assert np.asarray(vals[2]).shape == (1, 3, 4)
+
+
+def test_detection_output_and_map():
+    loc = fluid.layers.data("loc", [4, 4], append_batch_size=False)
+    conf = fluid.layers.data("conf", [1, 2, 4], append_batch_size=False)
+    pb = fluid.layers.data("pb", [4, 4], append_batch_size=False)
+    pbv = fluid.layers.data("pbv", [4, 4], append_batch_size=False)
+    out = fluid.layers.detection_output(loc, conf, pb, pbv)
+    det = fluid.layers.data("det", [6])
+    gt = fluid.layers.data("gt", [5])
+    m = fluid.layers.detection_map(det, gt)
+    rng = np.random.RandomState(0)
+    vals = _run([out, m], {
+        "loc": np.zeros((4, 4), np.float32),
+        "conf": rng.rand(1, 2, 4).astype(np.float32),
+        "pb": np.abs(rng.rand(4, 4)).astype(np.float32),
+        "pbv": np.full((4, 4), 0.1, np.float32),
+        "det": np.array([[0, 0.9, 0, 0, 10, 10]], np.float32),
+        "gt": np.array([[0, 0, 0, 10, 10]], np.float32)})
+    assert np.asarray(vals[0]).shape[-1] == 6
+    assert 0.0 <= float(np.asarray(vals[1]).reshape(-1)[0]) <= 1.0 + 1e-6
+
+
+def test_create_parameter_counter_print_nce():
+    w = fluid.layers.create_parameter([3, 2], "float32", name="cp_w")
+    ctr = fluid.layers.autoincreased_step_counter()
+    x = fluid.layers.data("x", [3])
+    pr = fluid.layers.Print(x, message="compat")
+    emb = fluid.layers.data("e", [8])
+    lbl = fluid.layers.data("l", [1], dtype="int64")
+    cost = fluid.layers.nce(emb, lbl, num_total_classes=6,
+                            num_neg_samples=2)
+    vals = _run([w, ctr, pr, cost], {
+        "x": np.ones((2, 3), np.float32),
+        "e": np.ones((2, 8), np.float32),
+        "l": np.zeros((2, 1), np.int64)})
+    assert np.asarray(vals[0]).shape == (3, 2)
+    assert np.asarray(vals[3]).shape[0] == 2
