@@ -18,7 +18,8 @@ __all__ = [
     "target_assign", "prior_box", "box_coder", "multiclass_nms",
     "detection_output", "detection_map", "create_parameter",
     "autoincreased_step_counter", "shrink_memory",
-    "reorder_lod_tensor_by_rank",
+    "reorder_lod_tensor_by_rank", "batch", "shuffle", "double_buffer",
+    "open_recordio_file", "ConditionalBlock",
 ]
 
 
@@ -354,3 +355,76 @@ def reorder_lod_tensor_by_rank(x, rank_table):
     return _simple("reorder_lod_tensor_by_rank",
                    {"X": [x], "RankTable": [rank_table]}, {},
                    dtype=x.dtype)
+
+
+# -- reader-layer API (layers/io.py) ---------------------------------------
+# The reference's graph-reader ops (READER variables consumed by a `read`
+# op) are HOST readers in this design (SURVEY §7: the data plane stays on
+# the host; DeviceLoader overlaps the transfer). These aliases keep
+# reference scripts working: each takes/returns a host reader callable.
+
+def batch(reader, batch_size, drop_last=False):
+    from ..reader import batch as _batch
+    return _batch(reader, batch_size, drop_last=drop_last)
+
+
+def shuffle(reader, buffer_size):
+    from ..reader import shuffle as _shuffle
+    return _shuffle(reader, buffer_size)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Host-side prefetch decorator (create_double_buffer_reader_op
+    capability; device-side overlap is reader.DeviceLoader)."""
+    from ..reader import buffered
+    return buffered(reader, 2)
+
+
+def open_recordio_file(filename, shapes=None, lod_levels=None,
+                       dtypes=None):
+    """Host reader over the native chunked record format
+    (create_recordio_file_reader_op capability)."""
+    from .. import recordio
+
+    def _reader():
+        for rec in recordio.reader(filename):
+            yield rec
+
+    return _reader
+
+
+class ConditionalBlock:
+    """`with ConditionalBlock([cond]).block(): ...` — ops built inside
+    run only when cond holds (conditional_block_op.cc). Vars written in
+    the block must have a pre-set default (the false branch keeps it)."""
+
+    def __init__(self, inputs, is_scalar_condition=True, name=None):
+        self.cond = inputs[0] if isinstance(inputs, (list, tuple)) \
+            else inputs
+
+    def block(self):
+        from .control_flow import BlockGuard
+        from ..core.program import default_main_program
+        outer = self
+
+        class _Guard(BlockGuard):
+            def __init__(self):
+                super().__init__(default_main_program())
+
+            def __exit__(self, *exc):
+                program = self.program
+                sub_block = program.current_block()
+                super().__exit__(*exc)
+                if exc[0] is None:
+                    written = sorted({n for o in sub_block.ops
+                                      for ns in o.outputs.values()
+                                      for n in ns})
+                    program.current_block().append_op(
+                        type="conditional_block",
+                        inputs={"Condition": [outer.cond]},
+                        outputs={"Out": written},
+                        attrs={"sub_block": sub_block,
+                               "written_names": written})
+                return False
+
+        return _Guard()

@@ -195,6 +195,33 @@ def test_detection_output_and_map():
     assert 0.0 <= float(np.asarray(vals[1]).reshape(-1)[0]) <= 1.0 + 1e-6
 
 
+def test_conditional_block_and_reader_aliases():
+    x = fluid.layers.data("x", [2])
+    flag = fluid.layers.data("flag", [1], append_batch_size=False)
+    out = fluid.layers.fill_constant([2, 2], "float32", 0.0)
+    cond = fluid.layers.ConditionalBlock([flag])
+    with cond.block():
+        doubled = fluid.layers.scale(x, 2.0)
+        fluid.layers.assign(doubled, out)
+    xv = np.ones((2, 2), np.float32)
+    on, = _run([out], {"x": xv, "flag": np.ones((1,), np.float32)})
+    np.testing.assert_allclose(np.asarray(on), 2 * xv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    off, = exe.run(feed={"x": xv, "flag": np.zeros((1,), np.float32)},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(off), 0.0)
+
+    # host-reader aliases
+    def rdr():
+        for i in range(7):
+            yield [np.full((2,), i, np.float32)]
+
+    batched = fluid.layers.batch(
+        fluid.layers.shuffle(fluid.layers.double_buffer(rdr), 16), 2)
+    chunks = list(batched())
+    assert len(chunks) == 3   # 7 items, batch 2, drop tail
+
+
 def test_create_parameter_counter_print_nce():
     w = fluid.layers.create_parameter([3, 2], "float32", name="cp_w")
     ctr = fluid.layers.autoincreased_step_counter()
