@@ -43,10 +43,11 @@ def time_loop(run_step, args, items_per_batch, unit="items", sync=None):
         run_step(i)
     if sync:
         sync()
-    # best of N timing windows: the sandbox tunnel shows multi-x
-    # run-to-run variance (PERF.md "Measurement variance"), so a single
-    # window can record a stall, not the chip
-    best = None
+    # N timing windows: the sandbox tunnel shows multi-x run-to-run
+    # variance (PERF.md "Measurement variance"), so a single window can
+    # record a stall, not the chip. Report the MEDIAN window plus the
+    # spread so the recorded number carries its own error bar.
+    times = []
     step_no = args.skip_batch_num
     for _ in range(windows):
         t0 = time.perf_counter()
@@ -55,10 +56,15 @@ def time_loop(run_step, args, items_per_batch, unit="items", sync=None):
             step_no += 1
         if sync:
             sync()
-        mean = (time.perf_counter() - t0) / max(1, args.iterations)
-        best = mean if best is None else min(best, mean)
-    ips = items_per_batch / best
-    print("avg %.4f ms/batch, %.1f %s/sec" % (1000 * best, ips, unit))
+        times.append((time.perf_counter() - t0) / max(1, args.iterations))
+    times.sort()
+    median = times[len(times) // 2] if len(times) % 2 else \
+        0.5 * (times[len(times) // 2 - 1] + times[len(times) // 2])
+    ips = items_per_batch / median
+    print("median %.4f ms/batch over %d windows "
+          "(best %.4f, worst %.4f), %.1f %s/sec (best %.1f)"
+          % (1000 * median, len(times), 1000 * times[0], 1000 * times[-1],
+             ips, unit, items_per_batch / times[0]))
     return ips
 
 

@@ -239,7 +239,8 @@ class Executor:
                     if registry.is_host_op(o.type)]
         segmentable = (_flag_on("PADDLE_TPU_SEGMENT_COMPILE")
                        and (bwd_idx is None
-                            or all(i > bwd_idx for i in host_idx)))
+                            or all(i > bwd_idx for i in host_idx)
+                            or self._grad_leaves_concrete(ops, bwd_idx)))
         if segmentable:
             self._run_segments(ctx, ops, bwd_idx, program, block,
                                static_info, base_key, fetch_names)
@@ -247,6 +248,34 @@ class Executor:
             for o in ops:
                 _lower_op(ctx, o)
         else:
+            # interpreter path: pre-marker host ops that PRODUCE a wrt
+            # name (prefetch leaves) must run eagerly FIRST — the grad
+            # trace skips them and reads their outputs from base_env.
+            # Run the minimal dependency slice: the host ops plus any
+            # earlier op whose output (transitively) feeds their inputs
+            # (e.g. a compute op producing the lookup ids).
+            wrt_names, _ = self._parse_marker(ops[bwd_idx])
+            wrt = set(wrt_names)
+            pre = ops[:bwd_idx]
+            run_ids = set()
+            needed = set()
+            for o in pre:
+                if registry.is_host_op(o.type) and any(
+                        n in wrt for ns in o.outputs.values() for n in ns):
+                    run_ids.add(id(o))
+                    needed.update(n for ns in o.inputs.values()
+                                  for n in ns)
+            for o in reversed(pre):
+                if id(o) in run_ids:
+                    continue
+                if any(n in needed for ns in o.outputs.values()
+                       for n in ns):
+                    run_ids.add(id(o))
+                    needed.update(n for ns in o.inputs.values()
+                                  for n in ns)
+            for o in pre:
+                if id(o) in run_ids:
+                    _lower_op(ctx, o)
             self._lower_with_grad(ctx, ops, bwd_idx, program, block)
 
         for n in persistable:
@@ -267,6 +296,38 @@ class Executor:
     @staticmethod
     def _is_jit_value(v):
         return isinstance(v, (jax.Array, np.ndarray, np.generic))
+
+    @staticmethod
+    def _grad_leaves_concrete(ops, bwd_idx):
+        """True when host ops BEFORE the grad marker cannot break gradient
+        flow, so the step is still segment-compilable: every marker wrt
+        name must enter the marker's compute segment as a concrete leaf
+        (a parameter from the scope, or the output of a host op like
+        ``prefetch``). If any op at or before the last pre-marker host op
+        CONSUMES a wrt name — or a compute op PRODUCES one there — the
+        chain from that wrt to the loss would cross a segment boundary
+        and its gradient would silently be wrong → not segmentable.
+
+        This is what lifts the full-eager fallback for the distributed
+        sparse-embedding path (prefetch → fwd+bwd → sparse send): the
+        prefetched rows are a differentiable leaf of the compiled
+        segment, exactly like the reference's trainer treats the rows
+        fetched from the pserver (distribute_transpiler.py:201-255)."""
+        host_before = [i for i in range(bwd_idx)
+                       if registry.is_host_op(ops[i].type)]
+        if not host_before:
+            return True
+        h_last = max(host_before)
+        wrt_names, _ = Executor._parse_marker(ops[bwd_idx])
+        wrt = set(wrt_names)
+        for o in ops[:h_last + 1]:
+            ins = {n for ns in o.inputs.values() for n in ns}
+            if ins & wrt:
+                return False
+            outs = {n for ns in o.outputs.values() for n in ns}
+            if (outs & wrt) and not registry.is_host_op(o.type):
+                return False
+        return True
 
     def _run_segments(self, ctx, ops, bwd_idx, program, block, static_info,
                       base_key, fetch_names=()):
@@ -532,7 +593,16 @@ class Executor:
                                          fetch_names=getattr(
                                              ctx, "fetch_names", ()))
             fctx.check_nan = getattr(ctx, "check_nan", False)
+            wrt_set = set(wrt_names)
             for op in ops[:bwd_idx]:
+                # a host op (e.g. prefetch) that PRODUCES a wrt name is a
+                # gradient LEAF — its value is already bound as a param;
+                # re-running it would overwrite the tracer with a concrete
+                # value and silently zero that gradient
+                if registry.is_host_op(op.type) and any(
+                        n in wrt_set for ns in op.outputs.values()
+                        for n in ns):
+                    continue
                 _lower_op(fctx, op)
             # scalar objective: mean-reduce each target (loss is already
             # scalar in the common case; calc_gradient uses unit cotangents,

@@ -43,8 +43,11 @@ def _send(ctx, op):
             val = np.asarray(val)
         _client(ep).send_var(op.attr("send_names", names)[i]
                              if op.attr("send_names") else name, val)
-    for ep in set(eps):
-        if op.attr("sync", True):
+    # barrier EVERY transpiled endpoint, not just the ones that received
+    # a dense grad: a server owning only a sparse-table shard still needs
+    # this trainer's round signal (listen_and_serv fan_in semantics)
+    if op.attr("sync", True):
+        for ep in set(op.attr("endpoints") or eps):
             _client(ep).barrier()
 
 
@@ -52,6 +55,48 @@ def _send(ctx, op):
 def _send_barrier(ctx, op):
     for ep in (op.attr("endpoints") or []):
         _client(ep).barrier()
+
+
+@register("send_sparse", host=True)
+def _send_sparse(ctx, op):
+    """Route a distributed embedding-table gradient to its shards: pair
+    each prefetch's ids with the grad of its output rows, sum duplicate
+    ids, split by ``id % num_shards`` and SEND each part as SelectedRows
+    with GLOBAL row ids under ``grad_name`` (the reference trainer's
+    split_ids + send-of-SelectedRows, distribute_transpiler.py:201-255).
+    No barrier here — the program's trailing send_barrier closes the
+    round for every endpoint."""
+    eps = op.attr("epmap") or op.attr("endpoints") or []
+    grad_name = op.attr("grad_name")
+    height = int(op.attr("height"))
+    id_names = op.input("Ids")
+    grad_names = op.input("Grads")
+    all_ids = []
+    all_rows = []
+    for idn, gn in zip(id_names, grad_names):
+        ids = np.asarray(ctx.env[idn]).reshape(-1).astype(np.int64)
+        if ids.size == 0:
+            continue
+        g = np.asarray(ctx.env[gn])
+        g = g.reshape(ids.size, -1)
+        all_ids.append(ids)
+        all_rows.append(g)
+    if not all_ids:
+        return            # an empty batch sends nothing this round
+    ids = np.concatenate(all_ids)
+    rows = np.concatenate(all_rows)
+    # sum duplicate ids (a batch repeats hot ids; the update must see one
+    # accumulated row per id, lookup_table_grad SelectedRows semantics)
+    uniq, inv = np.unique(ids, return_inverse=True)
+    acc = np.zeros((len(uniq), rows.shape[1]), rows.dtype)
+    np.add.at(acc, inv, rows)
+    n = max(1, len(eps))
+    for i, ep in enumerate(eps):
+        mask = (uniq % n) == i
+        if not mask.any():
+            continue
+        _client(ep).send_var(
+            grad_name, SelectedRows(uniq[mask], acc[mask], height))
 
 
 @register("recv", host=True)
@@ -70,10 +115,13 @@ def _prefetch(ctx, op):
     (prefetch_op.cc + distributed lookup table)."""
     eps = op.attr("epmap") or op.attr("endpoints") or []
     table = op.attr("table_name")
-    ids = np.asarray(ctx.in1(op, "X")).reshape(-1).astype(np.int64)
-    # shard ids across endpoints like split_ids (round robin by id % n)
+    ids_arr = np.asarray(ctx.in1(op, "X"))
+    ids = ids_arr.reshape(-1).astype(np.int64)
+    # shard ids across endpoints like split_ids (round robin by id % n);
+    # UNIQUE ids per shard — a batch repeats hot ids, and SelectedRows
+    # merge would sum duplicate returned rows (it is a grad-accumulate)
     n = len(eps)
-    parts = [ids[ids % n == i] for i in range(n)]
+    parts = [np.unique(ids[ids % n == i]) for i in range(n)]
     merged = None
     for ep, part in zip(eps, parts):
         if len(part) == 0:
@@ -87,7 +135,11 @@ def _prefetch(ctx, op):
     lut = {int(r): i for i, r in enumerate(merged.rows)}
     out = np.stack([merged.value[lut[int(i)]] for i in ids]) \
         if len(ids) else np.zeros((0, width), np.float32)
-    ctx.set_out(op, "Out", out)
+    # embedding-layer output shape: ids shape (trailing 1 stripped) + [D]
+    lead = ids_arr.shape
+    if lead and lead[-1] == 1:
+        lead = lead[:-1]
+    ctx.set_out(op, "Out", out.reshape(tuple(lead) + (width,)))
 
 
 @register("listen_and_serv", host=True)
@@ -105,14 +157,42 @@ def _listen_and_serv(ctx, op):
     port_file = op.attr("port_file")
     param_names = op.attr("param_names") or []
     grad_names = op.attr("grad_names") or []
+    sparse_tables = dict(op.attr("sparse_tables") or {})
+    sparse_grad_of = {t + "@GRAD": t for t in sparse_tables}
     blocks = op.attr("optimize_blocks") or []
     if not isinstance(blocks, (list, tuple)):
         blocks = [blocks]
+    # every var the optimize blocks read or write, minus the per-round
+    # gradients: params AND optimizer state (moments, beta pows, lr).
+    # All of it must live in the server store ACROSS rounds — resetting
+    # adam moments every round would silently break stateful optimizers
+    # (ParameterServer2 keeps momentum buffers server-side the same way).
+    state_names = set(param_names)
+    for blk in blocks:
+        for op2 in blk.ops:
+            for coll in (op2.inputs, op2.outputs):
+                for ns in coll.values():
+                    state_names.update(ns)
+    state_names -= set(grad_names)
+    state_names -= {g for g in state_names if g.endswith("@GRAD")}
 
     def optimize_fn(store, merged_grads):
         env = dict(ctx.env)
         env.update(store)
         for g, val in merged_grads.items():
+            tbl = sparse_grad_of.get(g)
+            if tbl is not None and isinstance(val, SelectedRows):
+                # sharded-table grad: global row ids → this shard's
+                # compact local indices (g // n); KEEP SelectedRows so
+                # the optimizer applies a sparse row update, never a
+                # dense [V, D] materialization
+                meta = sparse_tables[tbl]
+                n = int(meta["num_shards"])
+                local_h = int(np.asarray(store[tbl]).shape[0]) \
+                    if tbl in store else -(-int(meta["height"]) // n)
+                env[g] = SelectedRows(np.asarray(val.rows) // n,
+                                      val.value, local_h)
+                continue
             env[g] = val if not isinstance(val, SelectedRows) \
                 else val.to_dense()
         sctx = LowerContext(env, ctx._rng_fn, executor=ctx.executor)
@@ -129,24 +209,24 @@ def _listen_and_serv(ctx, op):
                                    for n in ns)
                     continue
                 _lower_op(sctx, op2)
-        for p in param_names:
-            if p in env:
+        for p in state_names:
+            if p in env and p not in tainted:
                 store[p] = np.asarray(env[p])
 
     host, port = endpoint.rsplit(":", 1)
     server = VariableServer(host=host, port=int(port), fan_in=fan_in,
                             optimize_fn=optimize_fn, port_file=port_file,
-                            sync=sync_mode)
-    # publish initial params from the scope/env
-    for p in param_names:
+                            sync=sync_mode, sparse_tables=sparse_tables)
+    # publish initial params + optimizer state from the scope/env
+    for p in state_names:
         if p in ctx.env:
             server.store[p] = np.asarray(ctx.env[p])
     server.start()
     ctx.env["@PSERVER@"] = server
     if op.attr("blocking", True):
         server._shutdown.wait()
-    # commit updated params back
-    for p in param_names:
+    # commit updated params + state back
+    for p in state_names:
         if p in server.store:
             ctx.env[p] = server.store[p]
 

@@ -92,11 +92,17 @@ class VariableServer:
     round barrier, after which `optimize_fn` is invoked once per round."""
 
     def __init__(self, host="127.0.0.1", port=0, fan_in=1,
-                 optimize_fn=None, port_file=None, sync=True):
+                 optimize_fn=None, port_file=None, sync=True,
+                 sparse_tables=None):
         self.store = {}              # name -> np.ndarray
         self.grads = {}              # name -> list of pending grads
         self.fan_in = fan_in
         self.optimize_fn = optimize_fn
+        # name -> {"shard": i, "num_shards": n, "height": global_rows}:
+        # this server holds rows {g : g % n == i} of the GLOBAL table,
+        # stored compactly at local index g // n (mod-sharding, the
+        # split_ids placement — distribute_transpiler.py:201-255 parity)
+        self.sparse_tables = dict(sparse_tables or {})
         self.sync = sync             # False → async SGD: apply on arrival
         self._lock = threading.Lock()
         self._round_cv = threading.Condition(self._lock)
@@ -170,8 +176,18 @@ class VariableServer:
             ids = deserialize_var(payload).astype(np.int64).reshape(-1)
             with self._lock:
                 table = self.store.get(name)
+                meta = self.sparse_tables.get(name)
             if table is None:
                 _send_msg(sock, "MISS", name)
+            elif meta is not None:
+                # sharded table: global ids (all ≡ shard mod num_shards)
+                # index the compact local store at g // n
+                local = ids // int(meta["num_shards"])
+                rows = np.asarray(table)[np.clip(local, 0,
+                                                 len(table) - 1)]
+                _send_msg(sock, "VAL", name,
+                          serialize_var(SelectedRows(
+                              ids, rows, int(meta["height"]))))
             else:
                 rows = np.asarray(table)[np.clip(ids, 0,
                                                  len(table) - 1)]

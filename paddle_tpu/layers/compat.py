@@ -416,9 +416,13 @@ class ConditionalBlock:
                 sub_block = program.current_block()
                 super().__exit__(*exc)
                 if exc[0] is None:
+                    # Only vars of OUTER blocks are conditional outputs;
+                    # names created inside the sub-block are its private
+                    # temps and die with it (conditional_block_op.cc: the
+                    # op's Out is the parent-scope vars the block assigns).
                     written = sorted({n for o in sub_block.ops
                                       for ns in o.outputs.values()
-                                      for n in ns})
+                                      for n in ns} - set(sub_block.vars))
                     program.current_block().append_op(
                         type="conditional_block",
                         inputs={"Condition": [outer.cond]},

@@ -35,10 +35,13 @@ def sequence_parallel_attention(q, k, v, causal=False, variant="ring",
 
 
 def sparse_moe(x, num_experts, d_inner, capacity_factor=1.25,
-               param_attr=None, name=None):
-    """Switch-style MoE FFN over [B, T, D] (or [T, D]) input. Expert
+               top_k=1, return_overflow=False, param_attr=None, name=None):
+    """MoE FFN over [B, T, D] (or [T, D]) input: Switch top-1 (top_k=1)
+    or GShard top-2 with normalized combine weights (top_k=2). Expert
     weights are stacked [E, ...] and sharded on the ep mesh axis. Returns
-    (out, aux_loss) — add aux_loss (scaled) to the training cost."""
+    (out, aux_loss) — add aux_loss (scaled) to the training cost — plus
+    the scalar capacity-overflow fraction (the routing-health metric to
+    monitor) when return_overflow=True."""
     helper = LayerHelper("moe_ffn", param_attr=param_attr, name=name)
     d = int(x.shape[-1])
     gate = helper.create_parameter(helper.param_attr, shape=[d, num_experts],
@@ -59,12 +62,21 @@ def sparse_moe(x, num_experts, d_inner, capacity_factor=1.25,
 
     out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
     aux = helper.create_variable_for_type_inference("float32", shape=())
+    outputs = {"Out": [out], "AuxLoss": [aux]}
+    overflow = None
+    if return_overflow:
+        overflow = helper.create_variable_for_type_inference(
+            "float32", shape=())
+        overflow.stop_gradient = True
+        outputs["Overflow"] = [overflow]
     helper.append_op(
         type="moe_ffn",
         inputs={"X": [x], "GateW": [gate], "WUp": [w_up],
                 "WDown": [w_down]},
-        outputs={"Out": [out], "AuxLoss": [aux]},
-        attrs={"capacity_factor": capacity_factor})
+        outputs=outputs,
+        attrs={"capacity_factor": capacity_factor, "top_k": int(top_k)})
+    if return_overflow:
+        return out, aux, overflow
     return out, aux
 
 

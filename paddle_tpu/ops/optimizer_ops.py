@@ -12,6 +12,7 @@ buffer donation this compiles to a true in-place update on device.
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..core.selected_rows import SelectedRows
 
 
 def _upd(ctx, op, slot_in, slot_out, value):
@@ -23,11 +24,31 @@ def _upd(ctx, op, slot_in, slot_out, value):
         ctx.env[out[0]] = value
 
 
+def _sparse(g):
+    """(rows, values) for a SelectedRows grad, else None — optimizer ops
+    with a SelectedRows grad apply SPARSE row updates (sgd_op.cc /
+    adam_op lazy-mode SelectedRows branches): only touched rows of the
+    param (and moments) change — the pserver-side sharded-embedding
+    update path."""
+    if isinstance(g, SelectedRows):
+        rows = jnp.asarray(g.rows).reshape(-1)
+        vals = jnp.asarray(g.value).reshape((rows.shape[0], -1))
+        return rows, vals
+    return None
+
+
 @register("sgd")
 def _sgd(ctx, op):
     p = ctx.in1(op, "Param")
     g = ctx.in1(op, "Grad")
     lr = ctx.in1(op, "LearningRate")
+    sp = _sparse(g)
+    if sp is not None:
+        rows, vals = sp
+        p_new = jnp.asarray(p).at[rows].add(
+            (-lr * vals).reshape((rows.shape[0],) + p.shape[1:]))
+        _upd(ctx, op, "Param", "ParamOut", p_new)
+        return
     _upd(ctx, op, "Param", "ParamOut", p - lr * g)
 
 
@@ -72,6 +93,27 @@ def _adam(ctx, op):
     b1 = op.attr("beta1", 0.9)
     b2 = op.attr("beta2", 0.999)
     eps = op.attr("epsilon", 1e-8)
+    sp = _sparse(g)
+    if sp is not None:
+        # lazy Adam (adam_op SelectedRows branch): moments and param
+        # update only on the touched rows; untouched rows keep state
+        rows, vals = sp
+        tail = p.shape[1:]
+        gv = vals.reshape((rows.shape[0],) + tail)
+        p = jnp.asarray(p)
+        m1 = jnp.asarray(m1)
+        m2 = jnp.asarray(m2)
+        m1r = b1 * m1[rows] + (1 - b1) * gv
+        m2r = b2 * m2[rows] + (1 - b2) * gv * gv
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        p_new = p.at[rows].add(-lr_t * m1r / (jnp.sqrt(m2r) + eps))
+        _upd(ctx, op, "Moment1", "Moment1Out", m1.at[rows].set(m1r))
+        _upd(ctx, op, "Moment2", "Moment2Out", m2.at[rows].set(m2r))
+        _upd(ctx, op, "Param", "ParamOut", p_new)
+        if op.attr("update_beta_pow", False):
+            _upd(ctx, op, "Beta1Pow", "Beta1PowOut", b1p * b1)
+            _upd(ctx, op, "Beta2Pow", "Beta2PowOut", b2p * b2)
+        return
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * g * g
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)

@@ -68,22 +68,30 @@ def _sp_attention(ctx, op):
 
 @register("moe_ffn", stateful_rng=True)
 def _moe_ffn(ctx, op):
-    """Switch-style MoE FFN. Inputs X [B, T, D] or [T, D], GateW [D, E],
-    WUp [E, D, H], WDown [E, H, D]; attr capacity_factor. Outputs Out
-    (same shape as X) and AuxLoss (scalar load-balancing loss). Expert dim
-    rides the ep mesh axis via GSPMD when present."""
+    """MoE FFN: Switch top-1 (attr top_k=1) or GShard top-2 with
+    normalized combine weights (top_k=2). Inputs X [B, T, D] or [T, D],
+    GateW [D, E], WUp [E, D, H], WDown [E, H, D]; attrs capacity_factor,
+    top_k. Outputs Out (same shape as X), AuxLoss (scalar load-balancing
+    loss) and, when wired, Overflow (fraction of token-expert assignments
+    dropped by capacity — the routing-health metric). Expert dim rides
+    the ep mesh axis via GSPMD when present."""
     x = ctx.in1(op, "X")
     gate_w = ctx.in1(op, "GateW")
     w_up = ctx.in1(op, "WUp")
     w_down = ctx.in1(op, "WDown")
     cf = float(op.attr("capacity_factor", 1.25))
+    top_k = int(op.attr("top_k", 1))
     from ..parallel import moe
     shape = x.shape
     flat = x.reshape(-1, shape[-1])
-    out, aux = moe.moe_ffn(flat, gate_w, w_up, w_down, capacity_factor=cf,
-                           mesh=ctx.mesh if _mesh_axis(ctx, "ep") else None)
+    out, aux, stats = moe.moe_ffn(
+        flat, gate_w, w_up, w_down, capacity_factor=cf, top_k=top_k,
+        mesh=ctx.mesh if _mesh_axis(ctx, "ep") else None,
+        return_stats=True)
     ctx.set_out(op, "Out", out.reshape(shape))
     ctx.set_out(op, "AuxLoss", aux)
+    if op.output("Overflow"):
+        ctx.set_out(op, "Overflow", stats["overflow"])
 
 
 def _decoder_layer_apply(p, x, n_head):

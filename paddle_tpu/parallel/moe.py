@@ -36,21 +36,75 @@ def top1_gating(logits, capacity, rng=None, noise_std=0.0):
     return dispatch, combine, aux
 
 
+def topk_gating(logits, capacity, k=2, rng=None, noise_std=0.0):
+    """GShard-style top-k gating (top-2 is the standard MoE training
+    config). Combine weights are the k selected gate probabilities
+    NORMALIZED to sum to 1 per token; rank-0 choices claim expert queue
+    slots before rank-1 choices (GShard sec. 2.2). Tokens whose rank-r
+    choice overflows the expert's capacity lose that branch (no
+    renormalization after dropping, per the paper).
+
+    logits [T, E] → (dispatch [T, E, C], combine [T, E, C], aux_loss,
+    overflow_frac) where overflow_frac = dropped assignments / (T*k).
+    """
+    t, e = logits.shape
+    if noise_std and rng is not None:
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape)
+    # slot bookkeeping in float32 ALWAYS: a bf16 cumsum cannot represent
+    # integers past 256 exactly, so positions would collide silently
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, idxs = jax.lax.top_k(probs, k)                # [T, k]
+    weights = gate_vals / (jnp.sum(gate_vals, -1, keepdims=True) + 1e-9)
+
+    counts = jnp.zeros((e,), jnp.float32)    # slots CLAIMED per expert
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    kept_total = jnp.asarray(0.0, jnp.float32)
+    for r in range(k):
+        mask = jax.nn.one_hot(idxs[:, r], e)                 # [T, E] f32
+        pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask + counts * mask
+        keep = (pos < capacity) * mask                       # [T, E]
+        pos_tok = jnp.sum(pos * keep, axis=-1)               # [T]
+        pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity)
+        slot = keep[:, :, None] * pos_oh[:, None, :]         # [T, E, C]
+        dispatch = dispatch + slot
+        combine = combine + slot * weights[:, r][:, None, None]
+        # offset the next rank by slots actually CLAIMED (≤ capacity).
+        # Equivalent gating to the raw-count offset — once an expert
+        # overflows it is full under either bookkeeping — but counts
+        # stays a true slot count.
+        counts = counts + jnp.sum(keep, axis=0)
+        kept_total = kept_total + jnp.sum(keep)
+    overflow = jnp.clip(1.0 - kept_total / (t * k), 0.0, 1.0)
+    # load-balancing aux loss on the rank-0 assignment (GShard eq. 4)
+    density = jnp.mean(jax.nn.one_hot(idxs[:, 0], e), axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * (e ** 2) / e
+    dtype = logits.dtype
+    return dispatch.astype(dtype), combine.astype(dtype), aux, overflow
+
+
 def moe_ffn(x, gate_w, w_up, w_down, capacity_factor=1.25, rng=None,
-            mesh=None, ep_axis="ep"):
-    """Switch-style MoE FFN.
+            mesh=None, ep_axis="ep", top_k=1, return_stats=False):
+    """Switch-style (top_k=1) or GShard-style (top_k=2) MoE FFN.
 
     x       [T, D] tokens
     gate_w  [D, E]
     w_up    [E, D, H] stacked expert weights (shard on ep)
     w_down  [E, H, D]
-    Returns ([T, D], aux_loss).
+    Returns ([T, D], aux_loss), plus a stats dict ({"overflow": frac of
+    dropped token-expert assignments}) when return_stats=True.
     """
     t, d = x.shape
     e = gate_w.shape[1]
-    capacity = max(1, int(capacity_factor * t / e))
+    capacity = max(1, int(capacity_factor * top_k * t / e))
     logits = x @ gate_w
-    dispatch, combine, aux = top1_gating(logits, capacity, rng)
+    if top_k > 1:
+        dispatch, combine, aux, overflow = topk_gating(
+            logits, capacity, k=top_k, rng=rng)
+    else:
+        dispatch, combine, aux = top1_gating(logits, capacity, rng)
+        overflow = jnp.clip(1.0 - jnp.sum(dispatch) / t, 0.0, 1.0)
     # dispatch tokens to experts: [E, C, D]
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
     if mesh is not None and ep_axis in mesh.axis_names:
@@ -60,4 +114,6 @@ def moe_ffn(x, gate_w, w_up, w_down, capacity_factor=1.25, rng=None,
     h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, w_up))
     expert_out = jnp.einsum("ech,ehd->ecd", h, w_down)
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    if return_stats:
+        return out, aux, {"overflow": overflow}
     return out, aux

@@ -1,0 +1,256 @@
+"""Distributed lookup table end-to-end (round-2 verdict #3).
+
+The reference shards an `is_distributed` embedding table across pservers
+and rewrites the trainer: split_ids + prefetch of just the needed rows,
+sparse SelectedRows grads routed per shard
+(distribute_transpiler.py:201-255, operators/prefetch_op.cc,
+lookup_table_op.cc:81). Here the DistributeTranspiler performs the same
+rewrite over the Program IR: lookup_table → prefetch, table + optimizer
+state row-sharded (mod placement, compact ceil(V/n) local stores) across
+ALL servers, send_sparse routing deduped SelectedRows grads — and the
+trainer's fwd+bwd still runs as ONE compiled XLA segment (the prefetched
+rows are a concrete gradient leaf; no eager fallback).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed import ops as dist_ops
+from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+
+
+VOCAB, DIM = 10, 4
+
+
+def _probe_ports(n):
+    eps = []
+    for _ in range(n):
+        probe = VariableServer()
+        eps.append("127.0.0.1:%d" % probe.port)
+        probe.stop()
+    return eps
+
+
+def _build_net(optimizer, is_distributed):
+    """Embedding-MLP: ids -> distributed table -> fc -> mse loss."""
+    ids = fluid.layers.data("ids", [1], dtype="int64")
+    y = fluid.layers.data("y", [1])
+    emb = fluid.layers.embedding(
+        ids, size=[VOCAB, DIM], is_sparse=True,
+        is_distributed=is_distributed,
+        param_attr=fluid.ParamAttr(
+            name="dist_emb",
+            initializer=fluid.initializer.Constant(0.1)))
+    pred = fluid.layers.fc(
+        emb, 1, bias_attr=False,
+        param_attr=fluid.ParamAttr(
+            name="dist_fc_w",
+            initializer=fluid.initializer.Constant(0.2)))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    optimizer().minimize(loss)
+    return loss
+
+
+def _batches(steps):
+    # every vocab id appears in every batch: then dense Adam == lazy
+    # (row-sparse) Adam exactly — a row absent from a step would still
+    # get a moment-decay update under dense Adam but not under lazy
+    # Adam (the reference's SelectedRows adam is lazy too)
+    rng = np.random.RandomState(7)
+    out = []
+    for _ in range(steps):
+        ids = np.concatenate([
+            np.arange(VOCAB, dtype=np.int64),
+            rng.randint(0, VOCAB, size=(6,)).astype(np.int64)])[:, None]
+        yv = (ids.astype(np.float32) * 0.05 + 0.3)
+        out.append({"ids": ids, "y": yv})
+    return out
+
+
+def _run_local(optimizer, steps=5):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss = _build_net(optimizer, is_distributed=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for feed in _batches(steps):
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+        table = np.asarray(scope.find_var("dist_emb")).copy()
+        fc_w = np.asarray(scope.find_var("dist_fc_w")).copy()
+    return losses, table, fc_w
+
+
+def _run_distributed(optimizer, n_servers=2, steps=5):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    server_threads, server_scopes = [], []
+    eps = _probe_ports(n_servers)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss = _build_net(optimizer, is_distributed=True)
+        t = fluid.DistributeTranspiler(mode="pserver")
+        t.transpile(trainer_id=0, program=main,
+                    pservers=",".join(eps), trainers=1)
+
+        # the trainer program must hold NO lookup_table op and not
+        # initialize the [V, D] table (it lives only on the servers)
+        trainer_ops = [o.type for o in main.global_block().ops]
+        assert "lookup_table" not in trainer_ops
+        assert "prefetch" in trainer_ops
+        assert "send_sparse" in trainer_ops
+        startup_outs = {n for o in startup.global_block().ops
+                        for ns in o.outputs.values() for n in ns}
+        assert "dist_emb" not in startup_outs
+
+        for ep in eps:
+            pserver_prog = t.get_pserver_program(ep)
+            pstartup = t.get_startup_program(ep)
+            sscope = fluid.Scope()
+            exe_s = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(sscope):
+                exe_s.run(pstartup)
+            # each server holds only its ceil(V/n) row shard
+            shard = np.asarray(sscope.find_var("dist_emb"))
+            assert shard.shape == (-(-VOCAB // n_servers), DIM), shard.shape
+
+            def run(prog=pserver_prog, sc=sscope):
+                fluid.Executor(fluid.CPUPlace()).run(
+                    prog, feed={}, fetch_list=[], scope=sc)
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            server_threads.append(th)
+            server_scopes.append(sscope)
+        time.sleep(0.5)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        try:
+            for feed in _batches(steps):
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+            # trainer fwd+bwd ran as compiled segments, not the op
+            # interpreter (the lifted eager fallback)
+            assert [k for k in exe._cache if k[0] == "segment"], \
+                "sharded-table trainer was not segment compiled"
+            fc_w = np.asarray(scope.find_var("dist_fc_w")).copy()
+        finally:
+            for ep in eps:
+                try:
+                    cli = RPCClient(ep)
+                    cli.shutdown_server()
+                    cli.close()
+                except OSError:
+                    pass
+            dist_ops.reset_clients()
+        # the server commits its store to the scope after listen_and_serv
+        # returns — join before reading the shards
+        for th in server_threads:
+            th.join(timeout=5)
+        # reassemble the global table from the shards for comparison
+        table = np.zeros((VOCAB, DIM), np.float32)
+        for i, sscope in enumerate(server_scopes):
+            shard = np.asarray(sscope.find_var("dist_emb"))
+            for local in range(shard.shape[0]):
+                g = local * n_servers + i
+                if g < VOCAB:
+                    table[g] = shard[local]
+    return losses, table, fc_w
+
+
+def test_sharded_table_sgd_matches_local():
+    l_local, t_local, w_local = _run_local(
+        lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    l_dist, t_dist, w_dist = _run_distributed(
+        lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    np.testing.assert_allclose(l_dist, l_local, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(t_dist, t_local, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w_dist, w_local, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_table_lazy_adam_matches_local():
+    # dense Adam == lazy (row-sparse) Adam when moments start at zero:
+    # untouched rows see zero grads and zero moments, so they hold still
+    l_local, t_local, w_local = _run_local(
+        lambda: fluid.optimizer.Adam(learning_rate=0.05))
+    l_dist, t_dist, w_dist = _run_distributed(
+        lambda: fluid.optimizer.Adam(learning_rate=0.05))
+    np.testing.assert_allclose(l_dist, l_local, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(t_dist, t_local, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_dist, w_local, rtol=1e-4, atol=1e-5)
+
+
+def test_deepfm_distributed_tables_train():
+    """DeepFM with both FM tables `is_distributed` across two pservers:
+    the CTR workload SURVEY §7 M5 names, trained end-to-end sharded."""
+    from paddle_tpu.models import deepfm as dfm
+
+    eps = _probe_ports(2)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    server_threads = []
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fields = [fluid.layers.data("field_%d" % i, [1], dtype="int64")
+                  for i in range(4)]
+        label = fluid.layers.data("click", [1])
+        prob, logit = dfm.deepfm(fields, vocab_size=50, embed_dim=4,
+                                 dnn_dims=(16,), is_sparse=True,
+                                 is_distributed=True)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+        t = fluid.DistributeTranspiler(mode="pserver")
+        t.transpile(trainer_id=0, program=main,
+                    pservers=",".join(eps), trainers=1)
+        assert len(t._dist_tables) == 2   # fm_first_w, fm_second_w
+
+        for ep in eps:
+            pserver_prog = t.get_pserver_program(ep)
+            pstartup = t.get_startup_program(ep)
+            sscope = fluid.Scope()
+            with fluid.scope_guard(sscope):
+                fluid.Executor(fluid.CPUPlace()).run(pstartup)
+
+            def run(prog=pserver_prog, sc=sscope):
+                fluid.Executor(fluid.CPUPlace()).run(
+                    prog, feed={}, fetch_list=[], scope=sc)
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            server_threads.append(th)
+        time.sleep(0.5)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(3)
+        losses = []
+        try:
+            # one fixed batch with learnable labels (click = f(ids)):
+            # repeated steps must drive the loss down
+            feed = {"field_%d" % i:
+                    rng.randint(0, 50, (16, 1)).astype(np.int64)
+                    for i in range(4)}
+            feed["click"] = (feed["field_0"] % 2).astype(np.float32)
+            for _ in range(8):
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+        finally:
+            for ep in eps:
+                try:
+                    cli = RPCClient(ep)
+                    cli.shutdown_server()
+                    cli.close()
+                except OSError:
+                    pass
+            dist_ops.reset_clients()
+        for th in server_threads:
+            th.join(timeout=5)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses

@@ -219,7 +219,10 @@ def test_conditional_block_and_reader_aliases():
     batched = fluid.layers.batch(
         fluid.layers.shuffle(fluid.layers.double_buffer(rdr), 16), 2)
     chunks = list(batched())
-    assert len(chunks) == 3   # 7 items, batch 2, drop tail
+    # 7 items, batch 2: partial final batch is KEPT, matching the
+    # reference BatchReader (create_batch_reader_op.cc:70-79).
+    assert len(chunks) == 4
+    assert sum(len(c) for c in chunks) == 7
 
 
 def test_create_parameter_counter_print_nce():

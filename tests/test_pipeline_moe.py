@@ -105,3 +105,92 @@ def test_moe_under_ep_mesh():
     base, _ = parallel.moe_ffn(x, gate_w, w_up, w_down)
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_moe_top2_identical_experts_equals_dense():
+    # with normalized top-2 combine weights and all experts equal, the MoE
+    # output must equal the single dense FFN exactly (weights sum to 1)
+    rng = np.random.RandomState(5)
+    t, d, e, h = 16, 8, 4, 16
+    x = jnp.asarray(rng.randn(t, d).astype(np.float32))
+    gate_w = jnp.asarray(rng.randn(d, e).astype(np.float32))
+    wu = rng.randn(d, h).astype(np.float32) * 0.2
+    wd = rng.randn(h, d).astype(np.float32) * 0.2
+    w_up = jnp.asarray(np.tile(wu, (e, 1, 1)))
+    w_down = jnp.asarray(np.tile(wd, (e, 1, 1)))
+    out, aux = parallel.moe_ffn(x, gate_w, w_up, w_down, top_k=2,
+                                capacity_factor=4.0)
+    want = np.maximum(np.asarray(x) @ wu, 0) @ wd
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_top2_overflow_metric():
+    t, e, cap = 16, 2, 4
+    # all tokens pick expert 0 first (logit 10), expert 1 second (logit 5):
+    # rank-0 keeps cap of 16, rank-1 keeps cap of 16 → dropped 24/32
+    logits = jnp.asarray(np.tile([10.0, 5.0], (t, 1)).astype(np.float32))
+    dispatch, combine, aux, overflow = parallel.topk_gating(
+        logits, capacity=cap, k=2)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == cap and d[:, 1].sum() == cap
+    np.testing.assert_allclose(float(overflow), 24.0 / 32.0)
+    # kept combine weights normalized over the two selected gates
+    c = np.asarray(combine)
+    probs = np.asarray(jax.nn.softmax(logits, -1))[0]
+    np.testing.assert_allclose(c[0, 0].sum(),
+                               probs[0] / (probs[0] + probs[1]), rtol=1e-5)
+
+
+def test_moe_top2_under_ep_mesh_matches_local():
+    mesh = parallel.make_mesh({"ep": 4})
+    rng = np.random.RandomState(6)
+    t, d, e, h = 32, 8, 4, 8
+    x = jnp.asarray(rng.randn(t, d).astype(np.float32))
+    gate_w = jnp.asarray(rng.randn(d, e).astype(np.float32))
+    w_up = jnp.asarray(rng.randn(e, d, h).astype(np.float32) * 0.2)
+    w_down = jnp.asarray(rng.randn(e, h, d).astype(np.float32) * 0.2)
+    with mesh:
+        jit_moe = jax.jit(lambda *a: parallel.moe_ffn(*a, mesh=mesh,
+                                                      top_k=2))
+        out, aux = jit_moe(x, gate_w, w_up, w_down)
+    base, _ = parallel.moe_ffn(x, gate_w, w_up, w_down, top_k=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_top2_grads_reach_gate_and_experts():
+    rng = np.random.RandomState(7)
+    t, d, e, h = 16, 8, 4, 8
+    x = jnp.asarray(rng.randn(t, d).astype(np.float32))
+    params = {
+        "g": jnp.asarray(rng.randn(d, e).astype(np.float32)),
+        "u": jnp.asarray(rng.randn(e, d, h).astype(np.float32) * 0.2),
+        "d": jnp.asarray(rng.randn(e, h, d).astype(np.float32) * 0.2),
+    }
+
+    def loss(p):
+        out, aux = parallel.moe_ffn(x, p["g"], p["u"], p["d"], top_k=2)
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    for k in ("g", "u", "d"):
+        arr = np.asarray(g[k])
+        assert np.isfinite(arr).all() and np.abs(arr).max() > 0, k
+
+
+def test_sparse_moe_layer_top2_overflow_fetchable():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6, 16])
+        out, aux, ovf = fluid.layers.sparse_moe(
+            x, num_experts=4, d_inner=32, top_k=2, return_overflow=True)
+        loss = fluid.layers.mean(out) + fluid.layers.scale(aux, 0.01)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(8).randn(4, 6, 16).astype(np.float32)
+        l1, o1 = exe.run(feed={"x": xv}, fetch_list=[loss, ovf])
+    assert np.isfinite(np.asarray(l1)).all()
+    o1 = float(np.asarray(o1))
+    assert 0.0 <= o1 <= 1.0

@@ -10,8 +10,10 @@ themselves (listen_and_serv_op.cc). These tests pin:
    trainer program with send/recv against a live VariableServer;
  - the cache actually holds segment executables and re-running the
    same program does not add new entries (no per-step retrace);
- - sparse path (prefetch before the grad marker) still falls back to
-   the full interpreter and stays correct.
+ - sparse path (prefetch before the grad marker) is ALSO segment
+   compiled (round-2 verdict #3: the eager fallback is lifted) — the
+   prefetched rows enter the compiled fwd+bwd segment as a concrete
+   gradient leaf, and grads stay correct.
 """
 
 import threading
@@ -93,9 +95,11 @@ def test_segment_parity_with_full_eager_and_cache_reuse():
     assert losses_seg[-1] < losses_seg[0]
 
 
-def test_prefetch_before_marker_falls_back_to_interpreter():
-    """A host op feeding the forward (sparse embedding prefetch) keeps
-    the proven full-interpreter path: autodiff must trace through it."""
+def test_prefetch_before_marker_is_segment_compiled():
+    """A host op feeding the forward (sparse embedding prefetch) no
+    longer drops the step to the interpreter: the fwd+bwd still runs as
+    a compiled segment with the prefetched rows as a concrete input
+    (executor._grad_leaves_concrete), and gradients are exact."""
     table = np.arange(12, dtype=np.float32).reshape(6, 2)
     server = VariableServer(fan_in=1).start()
     ep = "127.0.0.1:%d" % server.port
@@ -129,10 +133,64 @@ def test_prefetch_before_marker_falls_back_to_interpreter():
         np.testing.assert_allclose(
             float(np.asarray(l)), float(table[[1, 3]].mean() * 2 * 0.5),
             rtol=1e-5)
-        assert not [k for k in exe._cache if k[0] == "segment"]
+        assert [k for k in exe._cache if k[0] == "segment"], \
+            "prefetch-bearing program was not segment compiled"
     try:
         cli = RPCClient(ep)
         cli.shutdown_server()
         cli.close()
     finally:
         dist_ops.reset_clients()
+
+
+def test_prefetch_wrt_leaf_interpreter_fallback_parity():
+    """With segment compilation OFF, the interpreter path must run the
+    wrt-producing host op (prefetch) eagerly BEFORE the grad trace and
+    still produce the same loss and grads w.r.t. the prefetched rows."""
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+
+    def run_once():
+        server = VariableServer(fan_in=1).start()
+        ep = "127.0.0.1:%d" % server.port
+        server.store["emb"] = table
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            ids = fluid.layers.data("ids", [1], dtype="int64")
+            blk = main.global_block()
+            blk.create_var(name="rows2", shape=[2, 2], dtype="float32")
+            blk.append_op("prefetch", {"X": ["ids"]}, {"Out": ["rows2"]},
+                          {"epmap": [ep], "endpoints": [ep],
+                           "table_name": "emb"})
+            rows_v = blk.var("rows2")
+            pred = fluid.layers.fc(rows_v, 1, bias_attr=False,
+                                   param_attr=fluid.ParamAttr(
+                                       name="w_pf2",
+                                       initializer=fluid.initializer
+                                       .Constant(0.5)))
+            loss = fluid.layers.mean(pred)
+            fluid.append_backward(loss, parameter_list=["w_pf2", "rows2"])
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            l, g = exe.run(main, feed={"ids": np.array([[1], [3]],
+                                                       np.int64)},
+                           fetch_list=[loss, "rows2@GRAD"])
+            out = float(np.asarray(l)), np.asarray(g).copy()
+        try:
+            cli = RPCClient(ep)
+            cli.shutdown_server()
+            cli.close()
+        finally:
+            dist_ops.reset_clients()
+        return out
+
+    l_seg, g_seg = run_once()
+    flags.set_flag("segment_compile", False)
+    try:
+        l_eager, g_eager = run_once()
+    finally:
+        flags.set_flag("segment_compile", None)
+    np.testing.assert_allclose(l_eager, l_seg, rtol=1e-6)
+    np.testing.assert_allclose(g_eager, g_seg, rtol=1e-6)
+    # grad of mean(rows @ w) wrt rows = w/N broadcast
+    np.testing.assert_allclose(g_seg, np.full((2, 2), 0.5 / 2), rtol=1e-6)
