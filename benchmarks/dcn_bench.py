@@ -1,0 +1,229 @@
+"""DCN (pserver RPC) tier throughput benchmark.
+
+Round-2 verdict #8: "nobody knows what the wire does to a 100MB param".
+Measures pserver-mode training samples/sec on localhost TCP with:
+  * a ~50MB dense fc param (every round ships grad out + param back),
+  * the sparse path (a ~50MB embedding table sharded across 2 pservers;
+    only touched rows ride the wire),
+against the same models trained locally (no RPC). Also reports raw
+serialize/deserialize and loopback socket bandwidth so the bottleneck is
+attributable. Run: JAX_PLATFORMS=cpu python benchmarks/dcn_bench.py
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.distributed import ops as dist_ops  # noqa: E402
+from paddle_tpu.distributed.rpc import (RPCClient, VariableServer,  # noqa: E402
+                                        serialize_var, deserialize_var)
+
+D_IN, D_OUT = 4096, 3200            # 4096*3200*4B = 52.4 MB dense param
+VOCAB, EDIM = 200_000, 64           # 200k*64*4B = 51.2 MB table
+BATCH = 256
+STEPS = 8
+
+
+def _probe_ports(n):
+    eps = []
+    for _ in range(n):
+        s = VariableServer()
+        eps.append("127.0.0.1:%d" % s.port)
+        s.stop()
+    return eps
+
+
+def bench_serde():
+    w = np.random.rand(D_IN, D_OUT).astype(np.float32)
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        blob = serialize_var(w)
+    t_ser = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        deserialize_var(blob)
+    t_de = (time.perf_counter() - t0) / reps
+    mb = w.nbytes / 1e6
+    print("serde: %.1f MB blob — serialize %.1f ms (%.1f GB/s), "
+          "deserialize %.1f ms (%.1f GB/s)"
+          % (mb, t_ser * 1e3, w.nbytes / t_ser / 1e9,
+             t_de * 1e3, w.nbytes / t_de / 1e9))
+
+
+def bench_loopback():
+    server = VariableServer().start()
+    cli = RPCClient("127.0.0.1:%d" % server.port)
+    w = np.random.rand(D_IN, D_OUT).astype(np.float32)
+    cli.put_var("w", w)
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        cli.send_var("w@GRAD", w)
+    t_up = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        cli.get_var("w")
+    t_down = (time.perf_counter() - t0) / reps
+    print("loopback RPC: push %.1f ms (%.1f GB/s), pull %.1f ms "
+          "(%.1f GB/s)"
+          % (t_up * 1e3, w.nbytes / t_up / 1e9,
+             t_down * 1e3, w.nbytes / t_down / 1e9))
+    cli.shutdown_server()
+    cli.close()
+
+
+def _dense_model():
+    x = fluid.layers.data("x", [D_IN])
+    y = fluid.layers.data("y", [D_OUT])
+    pred = fluid.layers.fc(x, D_OUT, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name="big_w"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    return loss
+
+
+def _feed_dense(rng):
+    return {"x": rng.rand(BATCH, D_IN).astype(np.float32),
+            "y": rng.rand(BATCH, D_OUT).astype(np.float32)}
+
+
+def bench_dense_local():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss = _dense_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = _feed_dense(rng)
+        exe.run(main, feed=feed, fetch_list=[loss])      # compile
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        dt = (time.perf_counter() - t0) / STEPS
+    print("dense local:   %7.1f samples/s (%.1f ms/step)"
+          % (BATCH / dt, dt * 1e3))
+    return BATCH / dt
+
+
+def bench_dense_pserver():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    eps = _probe_ports(1)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss = _dense_model()
+        t = fluid.DistributeTranspiler(mode="pserver")
+        t.transpile(trainer_id=0, program=main, pservers=eps[0],
+                    trainers=1)
+        pprog = t.get_pserver_program(eps[0])
+        pstart = t.get_startup_program(eps[0])
+        sscope = fluid.Scope()
+        with fluid.scope_guard(sscope):
+            fluid.Executor(fluid.CPUPlace()).run(pstart)
+        th = threading.Thread(
+            target=lambda: fluid.Executor(fluid.CPUPlace()).run(
+                pprog, feed={}, fetch_list=[], scope=sscope), daemon=True)
+        th.start()
+        time.sleep(0.5)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = _feed_dense(rng)
+        exe.run(main, feed=feed, fetch_list=[loss])      # compile
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        dt = (time.perf_counter() - t0) / STEPS
+        cli = RPCClient(eps[0])
+        cli.shutdown_server()
+        cli.close()
+        dist_ops.reset_clients()
+        th.join(timeout=5)
+    print("dense pserver: %7.1f samples/s (%.1f ms/step, ~%.0f MB "
+          "wire/step)" % (BATCH / dt, dt * 1e3,
+                          2 * D_IN * D_OUT * 4 / 1e6))
+    return BATCH / dt
+
+
+def _sparse_model():
+    ids = fluid.layers.data("ids", [1], dtype="int64")
+    y = fluid.layers.data("y", [1])
+    emb = fluid.layers.embedding(
+        ids, size=[VOCAB, EDIM], is_sparse=True, is_distributed=True,
+        param_attr=fluid.ParamAttr(name="big_table"))
+    pred = fluid.layers.fc(emb, 1, bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    return loss
+
+
+def bench_sparse_pserver():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    eps = _probe_ports(2)
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss = _sparse_model()
+        t = fluid.DistributeTranspiler(mode="pserver")
+        t.transpile(trainer_id=0, program=main, pservers=",".join(eps),
+                    trainers=1)
+        threads = []
+        for ep in eps:
+            pprog = t.get_pserver_program(ep)
+            pstart = t.get_startup_program(ep)
+            sscope = fluid.Scope()
+            with fluid.scope_guard(sscope):
+                fluid.Executor(fluid.CPUPlace()).run(pstart)
+            th = threading.Thread(
+                target=lambda p=pprog, s=sscope:
+                fluid.Executor(fluid.CPUPlace()).run(
+                    p, feed={}, fetch_list=[], scope=s), daemon=True)
+            th.start()
+            threads.append(th)
+        time.sleep(0.5)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"ids": rng.randint(0, VOCAB, (BATCH, 1)).astype(np.int64),
+                "y": rng.rand(BATCH, 1).astype(np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss])      # compile
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        dt = (time.perf_counter() - t0) / STEPS
+        for ep in eps:
+            try:
+                cli = RPCClient(ep)
+                cli.shutdown_server()
+                cli.close()
+            except OSError:
+                pass
+        dist_ops.reset_clients()
+        for th in threads:
+            th.join(timeout=5)
+    wire_kb = BATCH * EDIM * 4 * 2 / 1e3
+    print("sparse pserver (%.0f MB table sharded x2): %7.1f samples/s "
+          "(%.1f ms/step, ~%.0f KB wire/step)"
+          % (VOCAB * EDIM * 4 / 1e6, BATCH / dt, dt * 1e3, wire_kb))
+    return BATCH / dt
+
+
+def main():
+    bench_serde()
+    bench_loopback()
+    local = bench_dense_local()
+    dense = bench_dense_pserver()
+    sparse = bench_sparse_pserver()
+    print("dense pserver/local ratio: %.2f" % (dense / local))
+    return {"dense_local": local, "dense_pserver": dense,
+            "sparse_pserver": sparse}
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Pipeline (GPipe) throughput + bubble-fraction benchmark.
+
+Runs the pipelined decoder stack on a virtual pp mesh (CPU devices) and
+measures tokens/sec as the microbatch count M grows, comparing the
+throughput ratio against the GPipe theory: useful fraction
+U(M) = M / (S + M - 1), so throughput(M) ≈ throughput(∞) · U(M).
+Run with:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python benchmarks/pipeline_bench.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from paddle_tpu import parallel  # noqa: E402
+
+
+def main(pp=4, d=256, d_inner=1024, t=64, mb=2, layers_per_stage=2,
+         ms=(1, 2, 4, 8, 16)):
+    mesh = parallel.make_mesh({"pp": pp})
+    rng = np.random.RandomState(0)
+
+    def mk(shape, scale):
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * scale)
+
+    params = {
+        "w1": mk((pp, layers_per_stage, d, d_inner), d ** -0.5),
+        "w2": mk((pp, layers_per_stage, d_inner, d), d_inner ** -0.5),
+    }
+
+    def stage_fn(p, x):
+        def body(carry, lp):
+            h = jnp.maximum(carry @ lp["w1"], 0.0)
+            return carry + h @ lp["w2"], None
+
+        out, _ = lax.scan(body, x, p)
+        return out
+
+    results = {}
+    for m in ms:
+        xs = jnp.asarray(rng.randn(m, mb, t, d).astype(np.float32))
+
+        def run(xs=xs):
+            return parallel.gpipe(stage_fn, params, xs, mesh,
+                                  axis_name="pp")
+
+        jit_run = jax.jit(run)
+        jax.block_until_ready(jit_run())          # compile
+        n_rep = 3
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            jax.block_until_ready(jit_run())
+        dt = (time.perf_counter() - t0) / n_rep
+        toks = m * mb * t
+        results[m] = toks / dt
+        print("M=%2d  %8.0f tok/s  (%.1f ms/step)"
+              % (m, toks / dt, dt * 1000))
+
+    # bubble analysis: throughput(M) / throughput(M_max) vs U(M)/U(M_max)
+    m_max = max(ms)
+    print("\nGPipe bubble check (S=%d): measured vs theory U(M)=M/(S+M-1)"
+          % pp)
+    for m in ms:
+        meas = results[m] / results[m_max]
+        theo = (m / (pp + m - 1)) / (m_max / (pp + m_max - 1))
+        print("M=%2d  measured ratio %.2f   theory %.2f" % (m, meas, theo))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -116,6 +116,17 @@ class Executor:
         self.place = place
         self._cache = {}          # cache key -> (jitted fn, state_keys, static info)
         self._rng_counter = 0
+        import uuid
+        import weakref
+        # per-PROGRAM step counters for host-op send tags (retry
+        # idempotency): another host-op program run on this executor
+        # (e.g. an eval recv) must not advance a training program's
+        # round sequence. Entry: program -> [seq, program_nonce].
+        self._run_seqs = weakref.WeakKeyDictionary()
+        self._run_seq = 0         # the ACTIVE program's seq (set per run)
+        # incarnation nonce: a RESTARTED trainer's seq restarts at 0 —
+        # servers evict pending grads from the dead incarnation by it
+        self._incarnation = uuid.uuid4().hex[:8]
 
     # ------------------------------------------------------------------
     def close(self):
@@ -156,8 +167,23 @@ class Executor:
         # edge where the reference also left graph land.
         if any(registry.is_host_op(o.type)
                for o in program.global_block().ops):
-            return self._run_eager(program, feed_arrays, fetch_names,
-                                   scope, static_info, return_numpy)
+            # the send-tag sequence advances only on SUCCESS and is
+            # per-program: a retried step reuses its tag, so the server
+            # replaces (not doubles) the pending grad — elastic-recovery
+            # idempotency. The program nonce keeps two programs' tags
+            # distinct (a second SENDING program of the same grad names
+            # within one round is not supported).
+            import uuid
+            entry = self._run_seqs.get(program)
+            if entry is None:
+                entry = self._run_seqs.setdefault(
+                    program, [0, uuid.uuid4().hex[:4]])
+            self._run_seq = entry[0]
+            self._incarnation_active = self._incarnation + entry[1]
+            result = self._run_eager(program, feed_arrays, fetch_names,
+                                     scope, static_info, return_numpy)
+            entry[0] += 1
+            return result
 
         from ..amp import amp_enabled
         check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
@@ -176,9 +202,20 @@ class Executor:
             np.uint32(program.random_seed * 1000003 + self._rng_counter))
         self._rng_counter += 1
 
+        from .. import profiler as _prof
         with jax.default_device(self.place.jax_device()):
-            fetches, new_state, guards, fetch_lods = entry(
-                state, feed_arrays, rng_key)
+            if _prof._enabled:
+                # step-level event; sync INSIDE the event so the row
+                # records real step time, not async dispatch; with
+                # profile_memory on it also samples live/peak HBM per
+                # compiled step (the step IS the op)
+                with _prof.RecordEvent("exe.run(compiled)"):
+                    fetches, new_state, guards, fetch_lods = entry(
+                        state, feed_arrays, rng_key)
+                    jax.block_until_ready(fetches)
+            else:
+                fetches, new_state, guards, fetch_lods = entry(
+                    state, feed_arrays, rng_key)
         fetches = self._trim_fetches(fetch_names, fetches, fetch_lods)
 
         # Commit updated persistable state back to the scope.
@@ -230,6 +267,9 @@ class Executor:
                                     static_info=static_info,
                                     fetch_names=fetch_names)
         ctx.check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
+        ctx.run_seq = self._run_seq   # send-tag round id (host ops)
+        ctx.incarnation = getattr(self, "_incarnation_active",
+                                  self._incarnation)
         bwd_idx = None
         for i, o in enumerate(ops):
             if o.type in ("backward_marker", "calc_gradient_marker"):
@@ -246,7 +286,7 @@ class Executor:
                                static_info, base_key, fetch_names)
         elif bwd_idx is None:
             for o in ops:
-                _lower_op(ctx, o)
+                _lower_op_eager(ctx, o)
         else:
             # interpreter path: pre-marker host ops that PRODUCE a wrt
             # name (prefetch leaves) must run eagerly FIRST — the grad
@@ -375,7 +415,7 @@ class Executor:
         for seg_no, (kind, idx_ops) in enumerate(segments):
             if kind == "host":
                 for _, o in idx_ops:
-                    _lower_op(ctx, o)
+                    _lower_op_eager(ctx, o)
                 continue
             seg_ops = [o for _, o in idx_ops]
             start = idx_ops[0][0]
@@ -819,6 +859,29 @@ class Executor:
             raise FloatingPointError(
                 "NaN/Inf detected in output %r of op %r "
                 "(PADDLE_TPU_CHECK_NAN_INF)" % (var, op_type))
+
+
+def _lower_op_eager(ctx, op):
+    """_lower_op on CONCRETE values (the interpreter / host-segment
+    path) with per-op profiling: each op gets its own RecordEvent, and
+    with FLAGS profile_memory on, outputs sync before the memory sample
+    so live/peak bytes attribute to THIS op — the reference's
+    FLAGS_benchmark per-op wait+log (operator.cc:576-578), which also
+    only existed in its interpreter."""
+    from .. import profiler as _prof
+    if not _prof._enabled:
+        _lower_op(ctx, op)
+        return
+    with _prof.RecordEvent("op:%s" % op.type):
+        _lower_op(ctx, op)
+        if _prof.memory_enabled():
+            outs = [ctx.env[n] for ns in op.outputs.values() for n in ns
+                    if n in ctx.env]
+            try:
+                jax.block_until_ready(
+                    [o for o in outs if isinstance(o, jax.Array)])
+            except Exception:
+                pass
 
 
 def _lower_op(ctx, op):

@@ -12,5 +12,7 @@ Two layers, per SURVEY.md §5.8 / §7:
 
 from .rpc import VariableServer, RPCClient  # noqa: F401
 from .transpiler import DistributeTranspiler  # noqa: F401
+from .membership import (KVServer, KVClient, register_pserver,  # noqa: F401
+                         wait_for_pservers, TrainerLease)
 from . import ops  # noqa: F401  (registers host ops)
 from . import launch  # noqa: F401

@@ -1,0 +1,283 @@
+"""Elastic membership: a TTL-lease KV service + pserver/trainer
+registration (the etcd tier of the reference's cloud runtime).
+
+Reference parity: go/pserver/etcd_client.go:43-100 — a pserver claims one
+of the `desired` index slots with a compare-and-swap under a TTL lease and
+keeps the lease alive with heartbeats; trainers rendezvous by watching
+until all slots are claimed. go/master/service.go uses the same store for
+master state. A dead server's lease expires, freeing its slot for a
+replacement, which recovers state from the last checkpoint
+(go/pserver/service.go:156-205 LoadCheckpoint).
+
+The store here is a small threaded TCP KV server (same length-prefixed
+framing as distributed/rpc.py) — sandbox-appropriate stand-in for etcd
+with the same semantics: PUT/GET/DEL, CAS (create-if-absent or
+compare-and-swap), LIST by prefix, per-key TTL refreshed by LEAS.
+"""
+
+import json
+import socketserver
+import threading
+import time
+
+from .rpc import _send_msg, _recv_msg
+
+__all__ = ["KVServer", "KVClient", "register_pserver", "wait_for_pservers",
+           "TrainerLease"]
+
+
+class KVServer:
+    """TTL-lease KV store (etcd stand-in)."""
+
+    def __init__(self, host="127.0.0.1", port=0, sweep_interval=0.1):
+        self._data = {}          # key -> (value str, expiry ts | None)
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, name, payload = _recv_msg(self.request)
+                        outer._dispatch(self.request, op, name, payload)
+                        if op == "EXIT":
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self.endpoint = "%s:%d" % (host, self.port)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, args=(sweep_interval,), daemon=True)
+
+    def start(self):
+        self._thread.start()
+        self._sweeper.start()
+        return self
+
+    def stop(self):
+        self._shutdown.set()
+        if self._thread.is_alive():
+            self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+    def _sweep_loop(self, interval):
+        while not self._shutdown.wait(interval):
+            now = time.time()
+            with self._lock:
+                dead = [k for k, (_, exp) in self._data.items()
+                        if exp is not None and exp < now]
+                for k in dead:
+                    del self._data[k]
+
+    def _alive(self, key):
+        ent = self._data.get(key)
+        if ent is None:
+            return None
+        if ent[1] is not None and ent[1] < time.time():
+            del self._data[key]
+            return None
+        return ent
+
+    def _dispatch(self, sock, op, name, payload):
+        body = json.loads(payload.decode()) if payload else {}
+        if op == "PUT":
+            ttl = body.get("ttl")
+            with self._lock:
+                self._data[name] = (body["value"],
+                                    time.time() + ttl if ttl else None)
+            _send_msg(sock, "OK")
+        elif op == "GET":
+            with self._lock:
+                ent = self._alive(name)
+            if ent is None:
+                _send_msg(sock, "MISS", name)
+            else:
+                _send_msg(sock, "VAL", name,
+                          json.dumps({"value": ent[0]}).encode())
+        elif op == "CAS":
+            # old == None → create-if-absent (etcd CompareAndSwap with
+            # prevExist=false, etcd_client.go:70)
+            ttl = body.get("ttl")
+            with self._lock:
+                ent = self._alive(name)
+                cur = ent[0] if ent is not None else None
+                if cur == body.get("old"):
+                    self._data[name] = (
+                        body["new"],
+                        time.time() + ttl if ttl else None)
+                    _send_msg(sock, "OK")
+                else:
+                    _send_msg(sock, "FAIL", name,
+                              json.dumps({"value": cur}).encode())
+        elif op == "DEL":
+            with self._lock:
+                self._data.pop(name, None)
+            _send_msg(sock, "OK")
+        elif op == "LIST":
+            with self._lock:
+                now = time.time()
+                out = {k: v for k, (v, exp) in self._data.items()
+                       if k.startswith(name)
+                       and (exp is None or exp >= now)}
+            _send_msg(sock, "VAL", name, json.dumps(out).encode())
+        elif op == "LEAS":
+            # refresh a key's TTL (lease keepalive)
+            ttl = body.get("ttl", 1.0)
+            with self._lock:
+                ent = self._alive(name)
+                if ent is None:
+                    _send_msg(sock, "MISS", name)
+                else:
+                    self._data[name] = (ent[0], time.time() + ttl)
+                    _send_msg(sock, "OK")
+        elif op == "EXIT":
+            _send_msg(sock, "OK")
+            self.stop()
+        else:
+            _send_msg(sock, "ERR", "unknown op %s" % op)
+
+
+class KVClient:
+    def __init__(self, endpoint, timeout=30.0):
+        import socket as _socket
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = _socket.create_connection((host, int(port)),
+                                               timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, op, name="", body=None):
+        with self._lock:
+            _send_msg(self._sock, op, name,
+                      json.dumps(body).encode() if body is not None
+                      else b"")
+            return _recv_msg(self._sock)
+
+    def put(self, key, value, ttl=None):
+        assert self._call("PUT", key, {"value": value, "ttl": ttl})[0] \
+            == "OK"
+
+    def get(self, key):
+        op, _, payload = self._call("GET", key)
+        if op == "MISS":
+            return None
+        return json.loads(payload.decode())["value"]
+
+    def cas(self, key, old, new, ttl=None):
+        """Atomically set key old→new (old None = create-if-absent).
+        Returns True on success."""
+        op, _, _ = self._call("CAS", key,
+                              {"old": old, "new": new, "ttl": ttl})
+        return op == "OK"
+
+    def delete(self, key):
+        self._call("DEL", key)
+
+    def list(self, prefix):
+        _, _, payload = self._call("LIST", prefix)
+        return json.loads(payload.decode())
+
+    def lease_keepalive(self, key, ttl):
+        return self._call("LEAS", key, {"ttl": ttl})[0] == "OK"
+
+    def shutdown_server(self):
+        try:
+            self._call("EXIT")
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+PS_PREFIX = "/ps/"
+TRAINER_PREFIX = "/trainer/"
+
+
+class _Lease:
+    """Heartbeat thread keeping one KV key alive (etcd lease keepalive)."""
+
+    def __init__(self, kv, key, ttl):
+        self.kv = kv
+        self.key = key
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.ttl / 3.0):
+            try:
+                self.kv.lease_keepalive(self.key, self.ttl)
+            except (ConnectionError, OSError):
+                return
+
+    def revoke(self):
+        """Stop heartbeating and delete the key (graceful leave)."""
+        self._stop.set()
+        try:
+            self.kv.delete(self.key)
+        except (ConnectionError, OSError):
+            pass
+
+
+def register_pserver(kv, desired, my_endpoint, ttl=1.0):
+    """Claim one of the `desired` pserver index slots with CAS under a
+    TTL lease (etcd_client.go:43-100). Returns (index, lease). A crashed
+    server's slot frees itself when its lease expires; the replacement
+    claims the SAME index and recovers that shard's checkpoint."""
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        for i in range(desired):
+            key = PS_PREFIX + str(i)
+            if kv.cas(key, None, my_endpoint, ttl=ttl):
+                return i, _Lease(kv, key, ttl)
+        time.sleep(ttl / 4.0)
+    raise TimeoutError("no free pserver slot out of %d" % desired)
+
+
+def wait_for_pservers(kv, desired, timeout=30.0):
+    """Rendezvous: block until all `desired` slots are claimed; returns
+    the endpoint list ordered by slot index."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        claimed = kv.list(PS_PREFIX)
+        if len(claimed) >= desired and all(
+                PS_PREFIX + str(i) in claimed for i in range(desired)):
+            return [claimed[PS_PREFIX + str(i)] for i in range(desired)]
+        time.sleep(0.05)
+    raise TimeoutError("pserver rendezvous: %d claimed of %d desired"
+                       % (len(kv.list(PS_PREFIX)), desired))
+
+
+class TrainerLease:
+    """Trainer membership: register under /trainer/<id> with a TTL
+    heartbeat; the master (or peers) can list live trainers. Leaving (or
+    dying) frees the id — join/leave mid-run is just lease lifecycle."""
+
+    def __init__(self, kv, trainer_id, ttl=1.0):
+        self.trainer_id = str(trainer_id)
+        self.key = TRAINER_PREFIX + self.trainer_id
+        kv.put(self.key, "alive", ttl=ttl)
+        self._lease = _Lease(kv, self.key, ttl)
+
+    @staticmethod
+    def live_trainers(kv):
+        return sorted(k[len(TRAINER_PREFIX):]
+                      for k in kv.list(TRAINER_PREFIX))
+
+    def leave(self):
+        self._lease.revoke()

@@ -31,24 +31,39 @@ def reset_clients():
     _CLIENTS.clear()
 
 
+def _round_tag(ctx, op):
+    """Idempotency tag for this trainer's current round:
+    t<trainer>:i<incarnation>:s<seq>. The server replaces a retried
+    (name, tag) send, drops sends/barriers of already-applied rounds,
+    and evicts pending grads of a dead incarnation (rpc.py SEND/BARR).
+    None when the executor doesn't track rounds."""
+    seq = getattr(ctx, "run_seq", None)
+    if seq is None:
+        return None
+    return "t%s:i%s:s%d" % (op.attr("trainer_id", 0),
+                            getattr(ctx, "incarnation", "0"), seq)
+
+
 @register("send", host=True)
 def _send(ctx, op):
     """Push each input var to its endpoint (send_op.cc / send_vars)."""
     eps = op.attr("epmap") or op.attr("endpoints") or []
     names = op.input("X")
+    tag = _round_tag(ctx, op)
     for i, name in enumerate(names):
         ep = eps[i % len(eps)]
         val = ctx.get(name)
         if not isinstance(val, SelectedRows):
             val = np.asarray(val)
         _client(ep).send_var(op.attr("send_names", names)[i]
-                             if op.attr("send_names") else name, val)
+                             if op.attr("send_names") else name, val,
+                             tag=tag)
     # barrier EVERY transpiled endpoint, not just the ones that received
     # a dense grad: a server owning only a sparse-table shard still needs
     # this trainer's round signal (listen_and_serv fan_in semantics)
     if op.attr("sync", True):
         for ep in set(op.attr("endpoints") or eps):
-            _client(ep).barrier()
+            _client(ep).barrier(tag=tag)
 
 
 @register("send_barrier", host=True)
@@ -91,12 +106,14 @@ def _send_sparse(ctx, op):
     acc = np.zeros((len(uniq), rows.shape[1]), rows.dtype)
     np.add.at(acc, inv, rows)
     n = max(1, len(eps))
+    tag = _round_tag(ctx, op)
     for i, ep in enumerate(eps):
         mask = (uniq % n) == i
         if not mask.any():
             continue
         _client(ep).send_var(
-            grad_name, SelectedRows(uniq[mask], acc[mask], height))
+            grad_name, SelectedRows(uniq[mask], acc[mask], height),
+            tag=tag)
 
 
 @register("recv", host=True)

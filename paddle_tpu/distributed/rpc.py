@@ -28,53 +28,112 @@ __all__ = ["VariableServer", "RPCClient", "serialize_var",
 _MAGIC = b"PTV1"
 
 
-def serialize_var(value):
-    """numpy array / SelectedRows → bytes (VariableMessage parity)."""
+def _serialize_parts(value):
+    """numpy array / SelectedRows → list of buffers (VariableMessage
+    parity). Scatter-gather: the value's own memory is one of the parts,
+    so a 100MB send never copies the tensor into an intermediate blob —
+    the wire-efficiency property the reference built zero-copy bytebuffer
+    streams for (operators/detail/variable_response.cc)."""
     if isinstance(value, SelectedRows):
         head = {"kind": "selected_rows", "height": value.height,
                 "rows_n": int(value.rows.shape[0]),
                 "dtype": str(value.value.dtype),
                 "shape": list(value.value.shape)}
         hb = json.dumps(head).encode()
-        return (struct.pack("<I", len(hb)) + hb +
-                value.rows.astype("<i8").tobytes() +
-                np.ascontiguousarray(value.value).tobytes())
-    arr = np.asarray(value)
+        return [struct.pack("<I", len(hb)), hb,
+                _array_buffer(value.rows.astype("<i8")),
+                _array_buffer(value.value)]
+    arr = np.ascontiguousarray(np.asarray(value))
     head = {"kind": "lod_tensor", "dtype": str(arr.dtype),
             "shape": list(arr.shape)}
     hb = json.dumps(head).encode()
-    return struct.pack("<I", len(hb)) + hb + \
-        np.ascontiguousarray(arr).tobytes()
+    return [struct.pack("<I", len(hb)), hb, _array_buffer(arr)]
+
+
+def _array_buffer(arr):
+    """Zero-copy byte view of an array; memoryview.cast rejects shapes
+    containing 0, so empty arrays fall back to b''."""
+    arr = np.ascontiguousarray(arr)
+    if arr.size == 0:
+        return b""
+    return memoryview(arr).cast("B")
+
+
+def serialize_var(value):
+    """numpy array / SelectedRows → one bytes blob (kept for tests and
+    checkpoint paths; the wire uses _serialize_parts without the join)."""
+    return b"".join(_serialize_parts(value))
 
 
 def deserialize_var(buf):
-    (hlen,) = struct.unpack("<I", buf[:4])
-    head = json.loads(buf[4:4 + hlen].decode())
-    body = buf[4 + hlen:]
+    (hlen,) = struct.unpack("<I", bytes(buf[:4]))
+    head = json.loads(bytes(buf[4:4 + hlen]).decode())
+    body = memoryview(buf)[4 + hlen:]
+    # np.frombuffer over the (private, per-message) receive buffer: when
+    # it is writable (bytearray from _recv_exact) the array shares it —
+    # no third copy of a large tensor
+    own = isinstance(buf, (bytearray, memoryview)) and not \
+        (isinstance(buf, memoryview) and buf.readonly)
     if head["kind"] == "selected_rows":
         n = head["rows_n"]
-        rows = np.frombuffer(body[:8 * n], "<i8").copy()
+        rows = np.frombuffer(body[:8 * n], "<i8")
         value = np.frombuffer(body[8 * n:],
-                              head["dtype"]).reshape(head["shape"]).copy()
+                              head["dtype"]).reshape(head["shape"])
+        if not own:
+            rows, value = rows.copy(), value.copy()
         return SelectedRows(rows, value, head["height"])
-    return np.frombuffer(body, head["dtype"]).reshape(head["shape"]).copy()
+    arr = np.frombuffer(body, head["dtype"]).reshape(head["shape"])
+    return arr if own else arr.copy()
+
+
+def _sendall_parts(sock, parts):
+    """sendall over a buffer list: scatter-gather sendmsg with
+    short-send handling (sendmsg is one syscall and may send less than
+    the total for large messages)."""
+    bufs = []
+    for p in parts:
+        mv = p if isinstance(p, memoryview) else memoryview(p)
+        if mv.ndim != 1 or mv.format != "B":
+            mv = mv.cast("B")
+        if len(mv):
+            bufs.append(mv)
+    while bufs:
+        try:
+            sent = sock.sendmsg(bufs)
+        except AttributeError:          # platform without sendmsg
+            for mv in bufs:
+                sock.sendall(mv)
+            return
+        while sent:
+            if sent >= len(bufs[0]):
+                sent -= len(bufs[0])
+                bufs.pop(0)
+            else:
+                bufs[0] = bufs[0][sent:]
+                sent = 0
 
 
 def _send_msg(sock, op, name="", payload=b""):
+    """payload: bytes or a list of buffers (scatter-gather, no join)."""
+    parts = payload if isinstance(payload, list) else [payload]
+    total = sum(len(p) for p in parts)
     nb = name.encode()
-    sock.sendall(struct.pack("<4sII", op.encode().ljust(4), len(nb),
-                             len(payload)) + nb + payload)
+    head = struct.pack("<4sII", op.encode().ljust(4), len(nb), total) + nb
+    _sendall_parts(sock, [head] + parts)
 
 
 def _recv_exact(sock, n):
-    chunks = []
-    while n:
-        c = sock.recv(min(n, 1 << 20))
-        if not c:
+    """Read exactly n bytes into ONE buffer via recv_into (no
+    chunk-append-join reassembly copies)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed")
-        chunks.append(c)
-        n -= len(c)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
 def _recv_msg(sock):
@@ -83,6 +142,19 @@ def _recv_msg(sock):
     name = _recv_exact(sock, nlen).decode() if nlen else ""
     payload = _recv_exact(sock, plen) if plen else b""
     return op.strip().decode(), name, payload
+
+
+def _parse_tag(tag):
+    """'t<id>:i<inc>:s<seq>' → ('t<id>:i<inc>', seq); else (None, None)."""
+    if not tag:
+        return None, None
+    parts = tag.split(":")
+    if len(parts) == 3 and parts[2][:1] == "s":
+        try:
+            return parts[0] + ":" + parts[1], int(parts[2][1:])
+        except ValueError:
+            pass
+    return None, None
 
 
 class VariableServer:
@@ -107,6 +179,8 @@ class VariableServer:
         self._lock = threading.Lock()
         self._round_cv = threading.Condition(self._lock)
         self._barrier_count = 0
+        self._barr_seen = set()      # tags counted toward THIS round
+        self._applied = {}           # "t<id>:i<inc>" -> last applied seq
         self._round = 0
         self._shutdown = threading.Event()
         outer = self
@@ -153,9 +227,27 @@ class VariableServer:
     def _dispatch(self, sock, op, name, payload):
         if op == "SEND":
             value = deserialize_var(payload)
+            # optional idempotency tag after "||": a retried send for the
+            # same (name, tag) REPLACES the pending grad instead of
+            # accumulating; a send whose round was ALREADY applied is
+            # dropped; pending grads from a dead incarnation of the same
+            # trainer are evicted — at-least-once trainer retries (elastic
+            # recovery) then yield exactly-once round semantics
+            tag = None
+            if "||" in name:
+                name, tag = name.split("||", 1)
+            pref, seq = _parse_tag(tag)
             if self.sync:
                 with self._lock:
-                    self.grads.setdefault(name, []).append(value)
+                    if pref is not None and \
+                            seq <= self._applied.get(pref, -1):
+                        _send_msg(sock, "OK")   # round already applied
+                        return
+                    if pref is not None:
+                        self._evict_stale_incarnation(pref)
+                    slot = self.grads.setdefault(name, {})
+                    slot[tag if tag is not None
+                         else "#%d" % len(slot)] = value
             else:
                 # Async SGD (ParameterServer2.h async paths /
                 # async_update.md): apply this gradient immediately under
@@ -171,7 +263,7 @@ class VariableServer:
             if val is None:
                 _send_msg(sock, "MISS", name)
             else:
-                _send_msg(sock, "VAL", name, serialize_var(val))
+                _send_msg(sock, "VAL", name, _serialize_parts(val))
         elif op == "PRFT":
             ids = deserialize_var(payload).astype(np.int64).reshape(-1)
             with self._lock:
@@ -186,21 +278,21 @@ class VariableServer:
                 rows = np.asarray(table)[np.clip(local, 0,
                                                  len(table) - 1)]
                 _send_msg(sock, "VAL", name,
-                          serialize_var(SelectedRows(
+                          _serialize_parts(SelectedRows(
                               ids, rows, int(meta["height"]))))
             else:
                 rows = np.asarray(table)[np.clip(ids, 0,
                                                  len(table) - 1)]
                 _send_msg(sock, "VAL", name,
-                          serialize_var(SelectedRows(ids, rows,
-                                                     len(table))))
+                          _serialize_parts(SelectedRows(ids, rows,
+                                                        len(table))))
         elif op == "PUT":
             with self._lock:
                 self.store[name] = np.asarray(deserialize_var(payload))
             _send_msg(sock, "OK")
         elif op == "BARR":
             if self.sync:
-                self._barrier(sock)
+                self._barrier(sock, name or None)
             else:
                 _send_msg(sock, "OK")   # async mode: barrier is a no-op
         elif op == "EXIT":
@@ -209,17 +301,58 @@ class VariableServer:
         else:
             _send_msg(sock, "ERR", "unknown op %s" % op)
 
-    def _barrier(self, sock):
+    def _evict_stale_incarnation(self, pref):
+        """Drop EVERYTHING a dead incarnation of this trainer left
+        behind: pending grads under every name, and its counted barrier
+        slots. Called (under the lock) whenever a tagged SEND or BARR
+        arrives — the replacement incarnation's first message cleans up
+        after the crash, across all names, so a half-step from the dead
+        process can never be merged into a round."""
+        tid = pref.split(":", 1)[0]
+
+        def stale(k):
+            return (isinstance(k, str) and k.startswith(tid + ":")
+                    and not k.startswith(pref + ":"))
+
+        for slot in self.grads.values():
+            for k in [k for k in slot if stale(k)]:
+                del slot[k]
+        dead_barrs = {t for t in self._barr_seen if stale(t)}
+        if dead_barrs:
+            self._barr_seen -= dead_barrs
+            self._barrier_count = max(
+                0, self._barrier_count - len(dead_barrs))
+
+    def _barrier(self, sock, tag=None):
         """Round barrier: after fan_in SENDs+BARRs, run the optimize step
         over accumulated grads, then release all waiters
-        (listen_and_serv_op.cc:100-168 RunSyncLoop)."""
+        (listen_and_serv_op.cc:100-168 RunSyncLoop).
+
+        Idempotency: a tagged barrier whose round was already applied
+        returns immediately; a RETRY of a tag already counted toward the
+        current round waits for the round without double-counting —
+        together with tagged SENDs this makes at-least-once trainer
+        retries exactly-once per round."""
+        pref, seq = _parse_tag(tag)
         with self._round_cv:
-            self._barrier_count += 1
+            if pref is not None and seq <= self._applied.get(pref, -1):
+                _send_msg(sock, "OK")   # this round already completed
+                return
+            if pref is not None:
+                self._evict_stale_incarnation(pref)
             my_round = self._round
+            counted = not (tag and tag in self._barr_seen)
+            if counted:
+                if tag:
+                    self._barr_seen.add(tag)
+                self._barrier_count += 1
             if self._barrier_count >= self.fan_in:
                 grads, self.grads = self.grads, {}
                 merged = {}
-                for name, glist in grads.items():
+                for name, slot in grads.items():
+                    glist = list(slot.values())
+                    if not glist:      # fully evicted (stale incarnation)
+                        continue
                     acc = glist[0]
                     for g in glist[1:]:
                         if isinstance(acc, SelectedRows):
@@ -229,7 +362,13 @@ class VariableServer:
                     merged[name] = acc
                 if self.optimize_fn is not None:
                     self.optimize_fn(self.store, merged)
+                for t in self._barr_seen:
+                    p, s = _parse_tag(t)
+                    if p is not None:
+                        self._applied[p] = max(self._applied.get(p, -1),
+                                               s)
                 self._barrier_count = 0
+                self._barr_seen = set()
                 self._round += 1
                 self._round_cv.notify_all()
             else:
@@ -327,8 +466,11 @@ class RPCClient:
         self._sock.settimeout(timeout)
         self._timeout = timeout
 
-    def send_var(self, name, value):
-        _send_msg(self._sock, "SEND", name, serialize_var(value))
+    def send_var(self, name, value, tag=None):
+        """tag: optional idempotency token — a retried send with the
+        same tag replaces the pending grad server-side (see SEND)."""
+        wire = name if tag is None else "%s||%s" % (name, tag)
+        _send_msg(self._sock, "SEND", wire, _serialize_parts(value))
         assert _recv_msg(self._sock)[0] == "OK"
 
     def get_var(self, name):
@@ -339,7 +481,7 @@ class RPCClient:
         return deserialize_var(payload)
 
     def put_var(self, name, value):
-        _send_msg(self._sock, "PUT", name, serialize_var(value))
+        _send_msg(self._sock, "PUT", name, _serialize_parts(value))
         assert _recv_msg(self._sock)[0] == "OK"
 
     def prefetch(self, table_name, ids):
@@ -350,8 +492,8 @@ class RPCClient:
             raise KeyError("server has no table %r" % table_name)
         return deserialize_var(payload)
 
-    def barrier(self):
-        _send_msg(self._sock, "BARR", "")
+    def barrier(self, tag=None):
+        _send_msg(self._sock, "BARR", tag or "")
         # no deadline: the server replies only after all fan_in trainers
         # arrive, which can take arbitrarily long (slow peers, compiles)
         self._sock.settimeout(None)

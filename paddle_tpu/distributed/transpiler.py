@@ -128,12 +128,14 @@ class DistributeTranspiler:
                         "Grads": [o + "@GRAD" for o in meta["out_names"]]},
                 outputs={},
                 attrs={"grad_name": w + "@GRAD", "epmap": self._eps,
-                       "endpoints": self._eps, "height": meta["height"]})
+                       "endpoints": self._eps, "height": meta["height"],
+                       "trainer_id": self._trainer_id})
         gb.append_op(type="send", inputs={"X": grads}, outputs={},
                      attrs={"epmap": [self._eps[i % n]
                                       for i in range(len(grads))],
                             "sync": self._sync,
-                            "endpoints": self._eps})
+                            "endpoints": self._eps,
+                            "trainer_id": self._trainer_id})
         gb.append_op(type="recv", inputs={},
                      outputs={"Out": params},
                      attrs={"epmap": [self._eps[i % n]

@@ -64,6 +64,9 @@ _register("segment_compile", bool, True,
           "interpreting the whole program op-by-op")
 _register("debug_nans", bool, False,
           "enable jax_debug_nans (XLA-level NaN localization)")
+_register("profile_memory", bool, False,
+          "record device live/peak bytes on every profiler event "
+          "(FLAGS_benchmark memory-logging parity, operator.cc:576-578)")
 _register("data_home", str,
           os.path.expanduser("~/.cache/paddle_tpu/dataset"),
           "dataset cache directory")

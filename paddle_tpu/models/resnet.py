@@ -76,9 +76,11 @@ def resnet_imagenet(input, depth=50, num_classes=1000):
 
 def build_train_net(model="resnet_cifar10", depth=None, image_shape=(3, 32, 32),
                     num_classes=10, learning_rate=0.01, image=None,
-                    label=None):
+                    label=None, optimize=True):
     """Returns (image, label, avg_cost, accuracy). Pass pre-built image/
-    label vars (e.g. in-graph synthetic data) to skip the feed layers."""
+    label vars (e.g. in-graph synthetic data) to skip the feed layers;
+    optimize=False builds fwd (+bwd via a later append_backward) without
+    the optimizer — the perf-probe ablation knob."""
     if image is None:
         image = fluid.layers.data("data", list(image_shape))
     if label is None:
@@ -90,6 +92,9 @@ def build_train_net(model="resnet_cifar10", depth=None, image_shape=(3, 32, 32),
     cost = fluid.layers.cross_entropy(predict, label)
     avg_cost = fluid.layers.mean(cost)
     acc = fluid.layers.accuracy(predict, label)
-    fluid.optimizer.Momentum(learning_rate=learning_rate,
-                             momentum=0.9).minimize(avg_cost)
+    if optimize:
+        fluid.optimizer.Momentum(learning_rate=learning_rate,
+                                 momentum=0.9).minimize(avg_cost)
+    else:
+        fluid.backward.append_backward(avg_cost)
     return image, label, avg_cost, acc

@@ -18,8 +18,12 @@
 //   void  pt_predictor_destroy(void* p);
 //   const char* pt_last_error();
 //
-// Single-feed single-fetch (the common serving shape); multi-io can layer
-// on the same mechanism. Thread-safety: calls serialize on the GIL.
+// Two run surfaces: pt_predictor_run (single float feed/fetch — the
+// common serving shape) and pt_predictor_run_multi (multiple NAMED typed
+// feeds and every model fetch, dtype codes 0=f32 1=i32 2=i64 — the
+// reference's Arguments-based C API, gradient_machine.h:36-62, which
+// carried typed matrices and ivectors for seq2seq-style models).
+// Thread-safety: calls serialize on the GIL.
 
 #include <Python.h>
 
@@ -70,6 +74,39 @@ class _CPredictor:
                                 fetch_list=self.fetches)
         out = np.ascontiguousarray(np.asarray(out), np.float32)
         return out.tobytes(), list(out.shape)
+
+    # dtype codes of the C ABI (gradient_machine.h Arguments carried
+    # typed matrices/ivectors; here: 0=float32, 1=int32, 2=int64)
+    _DT = {0: np.float32, 1: np.int32, 2: np.int64}
+    _DT_CODE = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
+                np.dtype(np.int64): 2}
+
+    def run_multi(self, names, bufs, shapes, dtypes):
+        """Multiple named typed feeds -> every fetch of the model, in
+        model order, each as (bytes, shape, dtype_code)."""
+        feed = {}
+        for nm, b, shp, dt in zip(names, bufs, shapes, dtypes):
+            feed[nm] = np.frombuffer(
+                b, self._DT[int(dt)]).reshape(shp).copy()
+        missing = [n for n in self.feeds if n not in feed]
+        if missing:
+            raise ValueError("missing feeds %s (model wants %s)"
+                             % (missing, self.feeds))
+        with fluid.scope_guard(self.scope):
+            outs = self.exe.run(self.prog, feed=feed,
+                                fetch_list=self.fetches)
+        res = []
+        for o in outs:
+            a = np.ascontiguousarray(np.asarray(o))
+            code = self._DT_CODE.get(a.dtype)
+            if code is None:
+                a = np.ascontiguousarray(a, np.float32)
+                code = 0
+            res.append((a.tobytes(), list(a.shape), code))
+        return res
+
+    def num_fetches(self):
+        return len(self.fetches)
 )PY";
 
 struct Predictor {
@@ -189,6 +226,112 @@ void pt_predictor_destroy(void* handle) {
   Py_XDECREF(p->obj);
   PyGILState_Release(gil);
   delete p;
+}
+
+// ---- multi-io surface (capi/gradient_machine.h:36-62 Arguments parity) --
+// dtype codes: 0=float32, 1=int32, 2=int64. Element sizes follow.
+
+static int64_t pt_dtype_size(int code) {
+  return code == 2 ? 8 : 4;
+}
+
+int pt_predictor_num_fetches(void* handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n = -1;
+  PyObject* r = PyObject_CallMethod(p->obj, "num_fetches", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    n = int(PyLong_AsLong(r));
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+// Feeds: n_in named typed buffers. Fetches: the model's fetch list in
+// order; out_bufs[i] has capacity out_caps_bytes[i] BYTES; shapes land in
+// out_shapes[i*8 .. i*8+7] (rank out_nds[i], max rank 8); dtype code in
+// out_dtypes[i]. Returns 0 on success.
+int pt_predictor_run_multi(void* handle, int n_in, const char** in_names,
+                           const void** in_bufs,
+                           const int64_t* const* in_shapes,
+                           const int* in_nds, const int* in_dtypes,
+                           int n_out, void** out_bufs,
+                           const int64_t* out_caps_bytes,
+                           int64_t* out_shapes, int* out_nds,
+                           int* out_dtypes) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* names = PyList_New(n_in);
+  PyObject* bufs = PyList_New(n_in);
+  PyObject* shapes = PyList_New(n_in);
+  PyObject* dtypes = PyList_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    int64_t n = 1;
+    for (int d = 0; d < in_nds[i]; ++d) n *= in_shapes[i][d];
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(in_names[i]));
+    PyList_SET_ITEM(
+        bufs, i,
+        PyMemoryView_FromMemory(
+            const_cast<char*>(static_cast<const char*>(in_bufs[i])),
+            n * pt_dtype_size(in_dtypes[i]), PyBUF_READ));
+    PyObject* shp = PyList_New(in_nds[i]);
+    for (int d = 0; d < in_nds[i]; ++d) {
+      PyList_SET_ITEM(shp, d, PyLong_FromLongLong(in_shapes[i][d]));
+    }
+    PyList_SET_ITEM(shapes, i, shp);
+    PyList_SET_ITEM(dtypes, i, PyLong_FromLong(in_dtypes[i]));
+  }
+  PyObject* res = PyObject_CallMethod(p->obj, "run_multi", "OOOO", names,
+                                      bufs, shapes, dtypes);
+  Py_DECREF(names);
+  Py_DECREF(bufs);
+  Py_DECREF(shapes);
+  Py_DECREF(dtypes);
+  if (res == nullptr) {
+    set_error_from_python();
+  } else {
+    int got = int(PyList_Size(res));
+    if (got != n_out) {
+      g_error = "model produced " + std::to_string(got) +
+                " fetches, caller expects " + std::to_string(n_out);
+    } else {
+      rc = 0;
+      for (int i = 0; i < got && rc == 0; ++i) {
+        PyObject* item = PyList_GetItem(res, i);   // (bytes, shape, code)
+        PyObject* vals = PyTuple_GetItem(item, 0);
+        PyObject* oshp = PyTuple_GetItem(item, 1);
+        int code = int(PyLong_AsLong(PyTuple_GetItem(item, 2)));
+        char* data = nullptr;
+        Py_ssize_t nbytes = 0;
+        PyBytes_AsStringAndSize(vals, &data, &nbytes);
+        int ond = int(PyList_Size(oshp));
+        if (nbytes > out_caps_bytes[i]) {
+          g_error = "output " + std::to_string(i) + " needs " +
+                    std::to_string(nbytes) + " bytes, buffer has " +
+                    std::to_string(out_caps_bytes[i]);
+          rc = -1;
+        } else if (ond > 8) {
+          g_error = "output rank exceeds the 8-slot out_shape contract";
+          rc = -1;
+        } else {
+          memcpy(out_bufs[i], data, size_t(nbytes));
+          for (int d = 0; d < ond; ++d) {
+            out_shapes[i * 8 + d] =
+                PyLong_AsLongLong(PyList_GetItem(oshp, d));
+          }
+          out_nds[i] = ond;
+          out_dtypes[i] = code;
+        }
+      }
+    }
+    Py_DECREF(res);
+  }
+  PyGILState_Release(gil);
+  return rc;
 }
 
 }  // extern "C"

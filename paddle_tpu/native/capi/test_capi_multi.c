@@ -1,0 +1,93 @@
+/* Multi-io C deployment test: drive a seq2seq-style inference model
+ * (int64 token ids + float mask in; int64 predicted ids + float32 probs
+ * out) from pure C — the reference capi's Arguments capability
+ * (gradient_machine.h:36-62).
+ * Usage: test_capi_multi <model_dir> <seq_len>
+ * Feeds src = [1..T] (int64, [1,T]) and mask = ones (float, [1,T]);
+ * prints "IDS ..." (output 0, int64) and "PROBS ..." (output 1, float32).
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pt_predictor_create(const char* model_dir);
+extern int pt_predictor_num_fetches(void* p);
+extern int pt_predictor_run_multi(
+    void* p, int n_in, const char** in_names, const void** in_bufs,
+    const int64_t* const* in_shapes, const int* in_nds,
+    const int* in_dtypes, int n_out, void** out_bufs,
+    const int64_t* out_caps_bytes, int64_t* out_shapes, int* out_nds,
+    int* out_dtypes);
+extern void pt_predictor_destroy(void* p);
+extern const char* pt_last_error(void);
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <seq_len>\n", argv[0]);
+    return 2;
+  }
+  int t = atoi(argv[2]);
+  if (t < 1 || t > 64) {
+    fprintf(stderr, "seq_len must be in [1, 64]\n");
+    return 2;
+  }
+  void* p = pt_predictor_create(argv[1]);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", pt_last_error());
+    return 1;
+  }
+  if (pt_predictor_num_fetches(p) != 2) {
+    fprintf(stderr, "expected a 2-fetch model, got %d\n",
+            pt_predictor_num_fetches(p));
+    return 1;
+  }
+
+  int64_t src[64];
+  float mask[64];
+  for (int i = 0; i < t; ++i) {
+    src[i] = i + 1;
+    mask[i] = 1.0f;
+  }
+  int64_t shape[2] = {1, t};
+  const char* names[2] = {"src", "mask"};
+  const void* bufs[2] = {src, mask};
+  const int64_t* shapes[2] = {shape, shape};
+  int nds[2] = {2, 2};
+  int dtypes[2] = {2, 0}; /* int64, float32 */
+
+  /* ids arrive int64 (code 2) or int32 (code 1) depending on the
+   * engine's index width — a typed ABI must carry either */
+  union {
+    int64_t i64[64];
+    int32_t i32[128];
+  } out_ids;
+  float out_probs[4096];
+  void* obufs[2] = {&out_ids, out_probs};
+  int64_t ocaps[2] = {sizeof(out_ids), sizeof(out_probs)};
+  int64_t oshapes[16];
+  int onds[2], odts[2];
+
+  if (pt_predictor_run_multi(p, 2, names, bufs, shapes, nds, dtypes, 2,
+                             obufs, ocaps, oshapes, onds, odts)) {
+    fprintf(stderr, "run failed: %s\n", pt_last_error());
+    return 1;
+  }
+  if ((odts[0] != 1 && odts[0] != 2) || odts[1] != 0) {
+    fprintf(stderr, "unexpected output dtypes %d %d\n", odts[0], odts[1]);
+    return 1;
+  }
+  int64_t n0 = 1, n1 = 1;
+  for (int d = 0; d < onds[0]; ++d) n0 *= oshapes[d];
+  for (int d = 0; d < onds[1]; ++d) n1 *= oshapes[8 + d];
+  printf("IDS");
+  for (int64_t i = 0; i < n0; ++i) {
+    long long v = odts[0] == 2 ? (long long)out_ids.i64[i]
+                               : (long long)out_ids.i32[i];
+    printf(" %lld", v);
+  }
+  printf("\nPROBS");
+  for (int64_t i = 0; i < n1; ++i) printf(" %.6f", out_probs[i]);
+  printf("\n");
+  pt_predictor_destroy(p);
+  return 0;
+}

@@ -7,6 +7,16 @@ design: stage parameters are STACKED on a leading [S, ...] axis sharded on
 shard, and activations ride the ICI ring via ``ppermute``. One jitted
 computation, S + M - 1 ticks for M microbatches (the classic GPipe bubble),
 differentiable end-to-end (grads flow through ppermute).
+
+Output handling: only the LAST stage produces real outputs, so the result
+leaves the shard_map with its leading axis sharded on ``pp`` and the
+caller slices stage S-1 — a single sliced transfer sized like the output,
+instead of an S-redundant psum of the whole buffer. Heterogeneous stages
+(per-stage parameter SHAPES) are supported by passing a list of per-stage
+param pytrees: those are replicated to every device and selected by
+``lax.switch`` on the stage index — functional, at the memory cost of
+holding all stages' params per device; the stacked form is the scalable
+path.
 """
 
 import functools
@@ -14,32 +24,30 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ._shard_map import shard_map
 
-def _gpipe_sharded(params, xs, stage_fn, axis_name):
-    """Inside shard_map. params: stage-local pytree (leading [1,...] leaves);
-    xs [M, mb, ...] microbatches (replicated). Returns [M, mb, ...] final-
-    stage outputs (valid on every shard; the last stage's results are
-    broadcast back through the ring)."""
-    s_idx = lax.axis_index(axis_name)
-    n_stage = lax.psum(1, axis_name)
+
+def _run_ticks(apply, xs, s_idx, n_stage, axis_name):
+    """The GPipe tick loop for one shard. apply: x -> stage output for
+    THIS stage. xs [M, mb, ...] microbatches (replicated or dp-sharded).
+    Returns [1, M, mb, ...]: final-stage outputs (zeros on other
+    shards). The buffer is allocated per shard (SPMD executes one
+    program), but only the last stage ever writes it."""
     m = xs.shape[0]
-    local_params = jax.tree_util.tree_map(lambda p: p[0], params)
 
     def tick(t, carry):
         state_in, outputs = carry
-        # stage 0 ingests microbatch t (zeros once drained)
         mb_idx = jnp.clip(t, 0, m - 1)
         inject = jnp.where(t < m, xs[mb_idx], jnp.zeros_like(xs[0]))
         inp = jnp.where(s_idx == 0, inject, state_in)
-        out = stage_fn(local_params, inp)
-        # last stage completed microbatch t-(S-1)
+        out = apply(inp)
         out_mb = t - (n_stage - 1)
         write = jnp.logical_and(s_idx == n_stage - 1, out_mb >= 0)
         upd = lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(write, out, outputs[jnp.clip(out_mb, 0, m - 1)]),
+            outputs,
+            jnp.where(write, out, outputs[jnp.clip(out_mb, 0, m - 1)]),
             jnp.clip(out_mb, 0, m - 1), 0)
         outputs = jnp.where(write, upd, outputs)
         state_next = lax.ppermute(
@@ -49,35 +57,76 @@ def _gpipe_sharded(params, xs, stage_fn, axis_name):
 
     state0 = jnp.zeros_like(xs[0])
     outputs0 = jnp.zeros_like(xs)
-    _, outputs = lax.fori_loop(0, n_stage + m - 1, tick, (state0, outputs0))
-    # broadcast final-stage outputs to every shard so out_specs can be
-    # replicated: non-final stages hold zeros, so a psum is an exact
-    # broadcast (and stays differentiable)
-    return lax.psum(outputs, axis_name)
+    _, outputs = lax.fori_loop(0, n_stage + m - 1, tick,
+                               (state0, outputs0))
+    # leading singleton axis: the caller's out_spec shards it on pp, so
+    # the global result is [S, M, mb, ...] and slicing [-1] pulls ONLY
+    # the last stage's buffer — no collective inside the loop or after
+    return outputs[None]
+
+
+def _gpipe_sharded(params, xs, stage_fn, axis_name):
+    """Stacked (homogeneous) path: params leaves arrive [1, ...] — this
+    shard's slice of the [S, ...] stack."""
+    s_idx = lax.axis_index(axis_name)
+    n_stage = lax.psum(1, axis_name)
+    local_params = jax.tree_util.tree_map(lambda p: p[0], params)
+    return _run_ticks(lambda x: stage_fn(local_params, x), xs, s_idx,
+                      n_stage, axis_name)
+
+
+def _gpipe_hetero(params_seq, xs, stage_fn, axis_name):
+    """Heterogeneous path: params_seq is a tuple of per-stage pytrees
+    (arbitrary, differing shapes), replicated; lax.switch picks this
+    stage's branch."""
+    s_idx = lax.axis_index(axis_name)
+    n_stage = lax.psum(1, axis_name)
+    branches = [functools.partial(stage_fn, p) for p in params_seq]
+    return _run_ticks(lambda x: lax.switch(s_idx, branches, x), xs, s_idx,
+                      n_stage, axis_name)
 
 
 def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
           batch_axis=None):
     """Run ``stage_fn(params_i, x)`` as an S-stage pipeline.
 
-    stacked_params: pytree whose leaves have leading dim S (= mesh[axis]);
+    stacked_params: EITHER a pytree whose leaves have leading dim S
+                    (= mesh[axis]) — sharded on ``axis_name``, the
+                    scalable form — OR a list/tuple of S per-stage
+                    pytrees with arbitrary per-stage shapes (replicated
+                    to every device, selected by stage index).
     microbatches:   [M, mb, ...] array of M microbatches.
     batch_axis:     mesh axis the mb dim is data-sharded on (e.g. "dp"),
                     None if replicated.
     Returns [M, mb, ...] outputs of the final stage.
     """
     s = mesh.shape[axis_name]
+    xspec = P(None, batch_axis)
+    out_spec = P(axis_name, None, batch_axis)
+
+    if isinstance(stacked_params, (list, tuple)):
+        if len(stacked_params) != s:
+            raise ValueError(
+                "per-stage params list has %d entries != %d pipeline "
+                "stages" % (len(stacked_params), s))
+        params_seq = tuple(stacked_params)
+        pspec = jax.tree_util.tree_map(lambda _: P(), params_seq)
+        fn = shard_map(
+            functools.partial(_gpipe_hetero, stage_fn=stage_fn,
+                              axis_name=axis_name),
+            mesh=mesh, in_specs=(pspec, xspec), out_specs=out_spec,
+            check_vma=False)
+        return fn(params_seq, microbatches)[-1]
+
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != s:
             raise ValueError(
                 "stacked_params leading dim %d != %d pipeline stages"
                 % (leaf.shape[0], s))
-
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    xspec = P(None, batch_axis)
     fn = shard_map(
         functools.partial(_gpipe_sharded, stage_fn=stage_fn,
                           axis_name=axis_name),
-        mesh=mesh, in_specs=(pspec, xspec), out_specs=xspec,
+        mesh=mesh, in_specs=(pspec, xspec), out_specs=out_spec,
         check_vma=False)
-    return fn(stacked_params, microbatches)
+    return fn(stacked_params, microbatches)[-1]

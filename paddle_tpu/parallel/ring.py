@@ -24,7 +24,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ._shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 _NEG_BIG = -1e30   # finite "-inf": keeps exp()==0 without inf-inf NaNs

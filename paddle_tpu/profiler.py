@@ -19,15 +19,51 @@ __all__ = ["profiler", "tpu_profiler", "cuda_profiler", "reset_profiler",
            "start_profiler", "stop_profiler", "RecordEvent",
            "export_chrome_trace"]
 
-_events = defaultdict(lambda: [0, 0.0])   # name -> [count, total_s]
+# name -> [count, total_s, live_bytes_last, peak_bytes_max]
+_events = defaultdict(lambda: [0, 0.0, 0, 0])
 _trace = []                               # (name, start_s, dur_s, thread)
 _trace_dropped = 0                        # spans past the cap
 _TRACE_CAP = 1_000_000                    # bound host memory on long runs
 _enabled = False
 
 
+def memory_enabled():
+    from . import flags
+    return flags.get_flag("profile_memory")
+
+
+def device_memory():
+    """(live_bytes, peak_bytes) on the first device. TPU backends expose
+    allocator stats via memory_stats(); the CPU backend reports the sum
+    of live jax array buffers (peak = running max of live)."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats and "bytes_in_use" in stats:
+        return (int(stats["bytes_in_use"]),
+                int(stats.get("peak_bytes_in_use",
+                              stats["bytes_in_use"])))
+    live = 0
+    try:
+        for a in jax.live_arrays():
+            live += a.nbytes
+    except Exception:
+        pass
+    global _cpu_peak
+    _cpu_peak = max(_cpu_peak, live)
+    return live, _cpu_peak
+
+
+_cpu_peak = 0
+
+
 class RecordEvent:
-    """RAII timing marker (platform/profiler.h RecordEvent parity)."""
+    """RAII timing marker (platform/profiler.h RecordEvent parity). With
+    FLAGS profile_memory on, also samples device live/peak bytes at exit
+    — the FLAGS_benchmark per-op memory log of the reference
+    (operator.cc:576-578), surfaced as table columns."""
 
     def __init__(self, name):
         self.name = name
@@ -43,6 +79,10 @@ class RecordEvent:
             ev = _events[self.name]
             ev[0] += 1
             ev[1] += now - self._t0
+            if memory_enabled():
+                live, peak = device_memory()
+                ev[2] = live
+                ev[3] = max(ev[3], peak)
             if len(_trace) < _TRACE_CAP:
                 import threading
                 _trace.append((self.name, self._t0, now - self._t0,
@@ -91,15 +131,23 @@ def start_profiler(state="All"):
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     global _enabled
     _enabled = False
-    rows = [(name, cnt, tot, tot / cnt if cnt else 0.0)
-            for name, (cnt, tot) in _events.items()]
+    with_mem = memory_enabled() or any(
+        v[3] for v in _events.values())
+    rows = [(name, cnt, tot, tot / cnt if cnt else 0.0, live, peak)
+            for name, (cnt, tot, live, peak) in _events.items()]
     key = {"total": 2, "calls": 1, "name": 0, "ave": 3,
            None: 2}.get(sorted_key, 2)
     rows.sort(key=lambda r: r[key], reverse=key != 0)
-    lines = ["%-40s %10s %14s %14s" % ("Event", "Calls", "Total(s)",
-                                       "Avg(s)")]
-    for name, cnt, tot, avg in rows:
-        lines.append("%-40s %10d %14.6f %14.6f" % (name, cnt, tot, avg))
+    header = "%-40s %10s %14s %14s" % ("Event", "Calls", "Total(s)",
+                                       "Avg(s)")
+    if with_mem:
+        header += " %14s %14s" % ("Live(MB)", "PeakHBM(MB)")
+    lines = [header]
+    for name, cnt, tot, avg, live, peak in rows:
+        line = "%-40s %10d %14.6f %14.6f" % (name, cnt, tot, avg)
+        if with_mem:
+            line += " %14.2f %14.2f" % (live / 1e6, peak / 1e6)
+        lines.append(line)
     report = "\n".join(lines)
     try:
         with open(profile_path + ".txt", "w") as f:

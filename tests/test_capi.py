@@ -59,3 +59,66 @@ def test_c_program_reports_missing_model(capi_bin):
                          capture_output=True, text=True, timeout=240)
     assert out.returncode != 0
     assert "failed" in out.stderr
+
+
+@pytest.fixture(scope="module")
+def capi_multi_bin():
+    try:
+        subprocess.run(["make", "-C", NATIVE, "build/libcapi.so",
+                        "build/test_capi_multi"],
+                       check=True, capture_output=True, text=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        pytest.skip("C API build failed: %s"
+                    % (getattr(e, "stderr", "") or str(e))[-400:])
+    return os.path.join(NATIVE, "build", "test_capi_multi")
+
+
+def test_c_program_multi_io_seq2seq(tmp_path, capi_multi_bin):
+    """2-in/2-out typed C inference (round-2 verdict #10): a seq2seq-style
+    model — int64 token ids + float mask in, int64 greedy next-token ids
+    + float32 probabilities out — driven end-to-end from pure C through
+    pt_predictor_run_multi."""
+    T, VOCAB, D = 4, 11, 16
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        src = fluid.layers.data("src", [T], dtype="int64")
+        mask = fluid.layers.data("mask", [T])
+        emb = fluid.layers.embedding(src, size=[VOCAB, D])      # [B,T,D]
+        masked = fluid.layers.elementwise_mul(
+            emb, fluid.layers.reshape(mask, [-1, T, 1]), axis=0)
+        enc = fluid.layers.reduce_sum(masked, dim=[1])          # [B,D]
+        logits = fluid.layers.fc(enc, VOCAB)                    # [B,V]
+        probs = fluid.layers.softmax(logits)
+        next_ids = fluid.layers.cast(
+            fluid.layers.argmax(logits, axis=-1), "int64")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        model_dir = str(tmp_path / "s2s")
+        fluid.io.save_inference_model(model_dir, ["src", "mask"],
+                                      [next_ids, probs], exe)
+        srcv = np.arange(1, T + 1, dtype=np.int64)[None, :]
+        maskv = np.ones((1, T), np.float32)
+        want_ids, want_probs = exe.run(
+            main, feed={"src": srcv, "mask": maskv},
+            fetch_list=[next_ids, probs])
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(NATIVE.rstrip("/")).rsplit(
+        "/paddle_tpu", 1)[0]
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([capi_multi_bin, model_dir, str(T)], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-500:]
+    ids_line = [l for l in out.stdout.splitlines()
+                if l.startswith("IDS")][0]
+    probs_line = [l for l in out.stdout.splitlines()
+                  if l.startswith("PROBS")][0]
+    got_ids = np.array([int(v) for v in ids_line.split()[1:]], np.int64)
+    got_probs = np.array([float(v) for v in probs_line.split()[1:]],
+                         np.float32)
+    np.testing.assert_array_equal(
+        got_ids, np.asarray(want_ids).reshape(-1))
+    np.testing.assert_allclose(
+        got_probs, np.asarray(want_probs).reshape(-1).astype(np.float32),
+        rtol=1e-4, atol=1e-6)

@@ -866,6 +866,7 @@ COVERED_ELSEWHERE = {
     "listen_and_serv": "test_distributed.py",
     "prefetch": "test_distributed.py",
     "split_ids": "test_distributed.py",
+    "send_sparse": "test_dist_lookup_table.py",
 }
 
 # ops with no one-op test by design; each entry documents why
