@@ -56,7 +56,10 @@ def main():
     scope_mod._global_scope = scope_mod.Scope()
     fluid.amp.enable_amp(False)
 
-    _run(["--batch_size", "32", "--iterations", "15",
+    # bs256: the throughput-saturating batch for the 4L/d512 config —
+    # bs32 is dispatch-latency-bound at less than half this rate
+    # (PERF.md batch sweep)
+    _run(["--batch_size", "256", "--iterations", "10",
           "--skip_batch_num", "3", "--device", "TPU",
           "--dtype", "bfloat16"])
     try:

@@ -57,17 +57,17 @@ def bench(tag, use_bn=True, bn_train=True, optimize=True):
         fetch = [avg_cost]
         if not optimize:
             # without optimizer ops nothing consumes the grads — XLA
-            # would DCE the whole backward; fetch the FIRST conv's
-            # weight grad (tiny, but forces the full backward chain)
+            # would DCE (part of) the backward. Consume EVERY param grad
+            # in-graph via a scalar grad-norm and fetch that: the full
+            # backward must run, and only a scalar crosses the tunnel.
             gb = main.global_block()
-            gname = sorted(n for n in gb.vars
-                           if n.endswith("@GRAD")
-                           and gb.vars[n].shape
-                           and int(np.prod(gb.vars[n].shape)) < 100000
-                           and "conv2d_0" in n)
-            fetch.append(gname[0] if gname else
-                         sorted(n for n in gb.vars
-                                if n.endswith("@GRAD"))[0])
+            terms = []
+            for p in gb.all_parameters():
+                gname = p.name + "@GRAD"
+                if gname in gb.vars:
+                    terms.append(fluid.layers.reduce_sum(
+                        fluid.layers.square(gb.var(gname))))
+            fetch.append(fluid.layers.sums(terms))
         fluid.amp.enable_amp()
         try:
             exe = fluid.Executor(fluid.TPUPlace(0))

@@ -123,7 +123,6 @@ class Executor:
         # (e.g. an eval recv) must not advance a training program's
         # round sequence. Entry: program -> [seq, program_nonce].
         self._run_seqs = weakref.WeakKeyDictionary()
-        self._run_seq = 0         # the ACTIVE program's seq (set per run)
         # incarnation nonce: a RESTARTED trainer's seq restarts at 0 —
         # servers evict pending grads from the dead incarnation by it
         self._incarnation = uuid.uuid4().hex[:8]
@@ -178,10 +177,13 @@ class Executor:
             if entry is None:
                 entry = self._run_seqs.setdefault(
                     program, [0, uuid.uuid4().hex[:4]])
-            self._run_seq = entry[0]
-            self._incarnation_active = self._incarnation + entry[1]
-            result = self._run_eager(program, feed_arrays, fetch_names,
-                                     scope, static_info, return_numpy)
+            # seq/incarnation travel as ARGUMENTS, not instance state:
+            # a shared Executor driven from two threads must not
+            # cross-tag rounds
+            result = self._run_eager(
+                program, feed_arrays, fetch_names, scope, static_info,
+                return_numpy, run_seq=entry[0],
+                incarnation=self._incarnation + entry[1])
             entry[0] += 1
             return result
 
@@ -234,7 +236,8 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _run_eager(self, program, feed_arrays, fetch_names, scope,
-                   static_info, return_numpy):
+                   static_info, return_numpy, run_seq=None,
+                   incarnation=None):
         """Execution path for programs containing host (IO) ops.
 
         The COMPUTE runs between host ops are jit-compiled per segment and
@@ -267,9 +270,8 @@ class Executor:
                                     static_info=static_info,
                                     fetch_names=fetch_names)
         ctx.check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
-        ctx.run_seq = self._run_seq   # send-tag round id (host ops)
-        ctx.incarnation = getattr(self, "_incarnation_active",
-                                  self._incarnation)
+        ctx.run_seq = run_seq         # send-tag round id (host ops)
+        ctx.incarnation = incarnation or self._incarnation
         bwd_idx = None
         for i, o in enumerate(ops):
             if o.type in ("backward_marker", "calc_gradient_marker"):

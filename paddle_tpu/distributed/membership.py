@@ -131,12 +131,18 @@ class KVServer:
                        and (exp is None or exp >= now)}
             _send_msg(sock, "VAL", name, json.dumps(out).encode())
         elif op == "LEAS":
-            # refresh a key's TTL (lease keepalive)
+            # refresh a key's TTL (lease keepalive); with "expect" set,
+            # refuse to refresh a key someone ELSE now owns — a stalled
+            # holder must not extend the usurper's lease
             ttl = body.get("ttl", 1.0)
+            expect = body.get("expect")
             with self._lock:
                 ent = self._alive(name)
                 if ent is None:
                     _send_msg(sock, "MISS", name)
+                elif expect is not None and ent[0] != expect:
+                    _send_msg(sock, "FAIL", name,
+                              json.dumps({"value": ent[0]}).encode())
                 else:
                     self._data[name] = (ent[0], time.time() + ttl)
                     _send_msg(sock, "OK")
@@ -187,8 +193,9 @@ class KVClient:
         _, _, payload = self._call("LIST", prefix)
         return json.loads(payload.decode())
 
-    def lease_keepalive(self, key, ttl):
-        return self._call("LEAS", key, {"ttl": ttl})[0] == "OK"
+    def lease_keepalive(self, key, ttl, expect=None):
+        return self._call("LEAS", key,
+                          {"ttl": ttl, "expect": expect})[0] == "OK"
 
     def shutdown_server(self):
         try:
@@ -208,12 +215,20 @@ TRAINER_PREFIX = "/trainer/"
 
 
 class _Lease:
-    """Heartbeat thread keeping one KV key alive (etcd lease keepalive)."""
+    """Heartbeat thread keeping one KV key alive (etcd lease keepalive).
 
-    def __init__(self, kv, key, ttl):
+    If the lease expired while we stalled (GC pause, compile), the next
+    heartbeat RECLAIMS the key with a CAS create-if-absent; if someone
+    else claimed it first, ``lost`` is set and heartbeating stops — the
+    owner must check ``lost`` and re-register rather than keep serving a
+    slot it no longer holds (split-brain guard)."""
+
+    def __init__(self, kv, key, ttl, value="alive"):
         self.kv = kv
         self.key = key
         self.ttl = ttl
+        self.value = value
+        self.lost = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -221,7 +236,17 @@ class _Lease:
     def _run(self):
         while not self._stop.wait(self.ttl / 3.0):
             try:
-                self.kv.lease_keepalive(self.key, self.ttl)
+                if self.kv.lease_keepalive(self.key, self.ttl,
+                                           expect=self.value):
+                    continue
+                # expired: try to reclaim our slot atomically
+                if self.kv.cas(self.key, None, self.value, ttl=self.ttl):
+                    continue
+                cur = self.kv.get(self.key)
+                if cur == self.value:       # raced with our own reclaim
+                    continue
+                self.lost = True            # someone else owns it now
+                return
             except (ConnectionError, OSError):
                 return
 
@@ -244,7 +269,7 @@ def register_pserver(kv, desired, my_endpoint, ttl=1.0):
         for i in range(desired):
             key = PS_PREFIX + str(i)
             if kv.cas(key, None, my_endpoint, ttl=ttl):
-                return i, _Lease(kv, key, ttl)
+                return i, _Lease(kv, key, ttl, value=my_endpoint)
         time.sleep(ttl / 4.0)
     raise TimeoutError("no free pserver slot out of %d" % desired)
 

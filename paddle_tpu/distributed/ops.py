@@ -15,20 +15,52 @@ from ..core.selected_rows import SelectedRows
 from .rpc import RPCClient
 
 
-_CLIENTS = {}
+import threading
+import weakref
+
+# Per-THREAD connection cache: RPCClient is a plain socket with no
+# framing lock, so two in-process trainers (threads) must not share one
+# — each thread keeps its own connections, like two trainer processes
+# would. The registry holds WEAK references: when a trainer thread
+# dies, its cache is collected (closing the sockets via refcount)
+# instead of pinning file descriptors forever. reset_clients() closes
+# every live thread's connections.
+
+
+class _Cache(dict):
+    """dict subclass so the registry can hold weak references."""
+
+    # dict disables hashing (value equality); the registry needs
+    # identity hashing to hold caches in a WeakSet
+    __hash__ = object.__hash__
+
+
+_TLS = threading.local()
+_ALL_CACHES = weakref.WeakSet()
+_ALL_LOCK = threading.Lock()
 
 
 def _client(ep):
-    cli = _CLIENTS.get(ep)
+    cache = getattr(_TLS, "clients", None)
+    if cache is None:
+        cache = _TLS.clients = _Cache()
+        with _ALL_LOCK:
+            _ALL_CACHES.add(cache)
+    cli = cache.get(ep)
     if cli is None:
-        cli = _CLIENTS[ep] = RPCClient(ep)
+        cli = cache[ep] = RPCClient(ep)
     return cli
 
 
 def reset_clients():
-    for cli in _CLIENTS.values():
-        cli.close()
-    _CLIENTS.clear()
+    # close + clear every live thread's connections; threads reconnect
+    # lazily on next use
+    with _ALL_LOCK:
+        caches = list(_ALL_CACHES)
+    for cache in caches:
+        for cli in cache.values():
+            cli.close()
+        cache.clear()
 
 
 def _round_tag(ctx, op):

@@ -20,6 +20,7 @@ __all__ = [
     "autoincreased_step_counter", "shrink_memory",
     "reorder_lod_tensor_by_rank", "batch", "shuffle", "double_buffer",
     "open_recordio_file", "ConditionalBlock",
+    "multi_box_head", "ssd_loss",
 ]
 
 
@@ -432,3 +433,124 @@ class ConditionalBlock:
                 return False
 
         return _Guard()
+
+
+def _num_priors_per_loc(min_sizes, max_sizes, aspect_ratios, flip):
+    """Priors per feature-map cell — mirrors the prior_box lowering's
+    aspect-ratio expansion (ops/detection_ops.py)."""
+    ars = [1.0]
+    for r in aspect_ratios or [1.0]:
+        if all(abs(r - a) > 1e-6 for a in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+    cnt = 0
+    for k, ms in enumerate(min_sizes):
+        for a in ars:
+            cnt += 1
+            if a == 1.0 and k < len(max_sizes or []):
+                cnt += 1
+    return cnt
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD detection head over multiple feature maps (reference
+    layers/detection.py multi_box_head): per input, generate priors and
+    predict per-prior location offsets + class confidences with convs;
+    concat across maps. Returns (mbox_locs [N, Np, 4],
+    mbox_confs [N, Np, C], boxes [Np, 4], variances [Np, 4])."""
+    import math
+    from .conv_layers import conv2d
+    from .tensor import concat, reshape, transpose
+
+    if not isinstance(inputs, (list, tuple)):
+        raise ValueError("inputs should be a list or tuple")
+    num_layer = len(inputs)
+    if num_layer <= 2:
+        assert min_sizes is not None and max_sizes is not None
+    elif min_sizes is None and max_sizes is None:
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    if steps:
+        step_w = step_h = steps
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        ms = ms if isinstance(ms, (list, tuple)) else [ms]
+        mx = (max_sizes or [None] * num_layer)[i]
+        mx = [] if mx is None else \
+            (mx if isinstance(mx, (list, tuple)) else [mx])
+        ar = (aspect_ratios or [None] * num_layer)[i]
+        ar = [1.0] if ar is None else \
+            (list(ar) if isinstance(ar, (list, tuple)) else [ar])
+        box, var = prior_box(
+            feat, image, ms, mx, ar, variance=variance, flip=flip,
+            clip=clip,
+            steps=[(step_w[i] if step_w else 0.0),
+                   (step_h[i] if step_h else 0.0)], offset=offset)
+        boxes_all.append(reshape(box, [-1, 4]))
+        vars_all.append(reshape(var, [-1, 4]))
+        p = _num_priors_per_loc(ms, mx, ar, flip)
+
+        loc = conv2d(feat, num_filters=p * 4,
+                     filter_size=kernel_size, padding=pad,
+                     stride=stride)
+        loc = transpose(loc, perm=[0, 2, 3, 1])
+        locs.append(reshape(loc, [0, -1, 4]))
+        cf = conv2d(feat, num_filters=p * num_classes,
+                    filter_size=kernel_size, padding=pad,
+                    stride=stride)
+        cf = transpose(cf, perm=[0, 2, 3, 1])
+        confs.append(reshape(cf, [0, -1, num_classes]))
+
+    mbox_locs = locs[0] if len(locs) == 1 else concat(locs, axis=1)
+    mbox_confs = confs[0] if len(confs) == 1 else concat(confs, axis=1)
+    boxes = boxes_all[0] if len(boxes_all) == 1 else \
+        concat(boxes_all, axis=0)
+    variances = vars_all[0] if len(vars_all) == 1 else \
+        concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=None, name=None):
+    """SSD multibox loss (reference layers/detection.py ssd_loss): IoU
+    matching + hard negative mining + softmax CE + smooth-l1, fused into
+    one batch-aware op (ops/detection_ops.py `ssd_loss`). location
+    [N, Np, 4], confidence [N, Np, C], gt_box/gt_label flat LoD
+    ([Ng, 4]/[Ng, 1]). Returns the per-image weighted loss [N, 1]."""
+    if mining_type != "max_negative":
+        raise ValueError("Only mining_type == 'max_negative' is "
+                         "supported (reference parity)")
+    helper = LayerHelper("ssd_loss", name=name)
+    out = helper.create_variable_for_type_inference(
+        "float32", shape=(location.shape[0], 1))
+    inputs = {"Loc": [location], "Conf": [confidence],
+              "GTBox": [gt_box], "GTLabel": [gt_label],
+              "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="ssd_loss", inputs=inputs, outputs={"Loss": [out]},
+        attrs={"background_label": int(background_label),
+               "overlap_threshold": float(overlap_threshold),
+               "neg_pos_ratio": float(neg_pos_ratio),
+               "neg_overlap": float(neg_overlap),
+               "loc_loss_weight": float(loc_loss_weight),
+               "conf_loss_weight": float(conf_loss_weight),
+               "match_type": match_type, "normalize": bool(normalize)})
+    return out

@@ -297,3 +297,141 @@ def _detection_map(ctx, op):
         ap = ap + p / 11.0
     ctx.set_out(op, "MAP", ap.reshape(1))
     ctx.set_out(op, "AccumPosCount", jnp.asarray([det.shape[0]]))
+
+
+def _greedy_bipartite(dist):
+    """dist [G, M] → col_match [M] int32 (greedy global-argmax matching,
+    bipartite_match_op.cc). Rows with all-zero dist never match."""
+    g, m = dist.shape
+
+    def body(_, carry):
+        d, col_match = carry
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        valid = d[i, j] > 0
+        col_match = jnp.where(valid, col_match.at[j].set(i), col_match)
+        d = jnp.where(valid, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+        return d, col_match
+
+    col_match = jnp.full((m,), -1, jnp.int32)
+    _, col_match = lax.fori_loop(0, min(g, m), body, (dist, col_match))
+    return col_match
+
+
+@register("ssd_loss")
+def _ssd_loss(ctx, op):
+    """Fused SSD multibox loss (reference layers/detection.py ssd_loss
+    composition: iou_similarity → bipartite_match → target_assign →
+    mine_hard_examples → box_coder → softmax CE + smooth-l1). The
+    reference chains 7 LoD-aware ops per image; on TPU one batch-aware
+    lowering with padded ground truth and a vmapped matcher compiles to
+    a single fused computation.
+
+    Inputs: Loc [N, M, 4], Conf [N, M, C], GTBox flat [G, 4] (+@LOD
+    lengths), GTLabel flat [G, 1], PriorBox [M, 4], PriorBoxVar [M, 4].
+    Output: Loss [N, 1] (normalized by the matched count when attr
+    `normalize`)."""
+    loc = ctx.in1(op, "Loc")
+    conf = ctx.in1(op, "Conf")
+    gt_box = ctx.in1(op, "GTBox")
+    gt_label = jnp.reshape(ctx.in1(op, "GTLabel"), (-1,)).astype(jnp.int32)
+    prior = ctx.in1(op, "PriorBox")
+    pvar_names = op.input("PriorBoxVar")
+    pvar = ctx.in1(op, "PriorBoxVar") if pvar_names else \
+        jnp.ones_like(prior)
+    background = int(op.attr("background_label", 0))
+    overlap_threshold = float(op.attr("overlap_threshold", 0.5))
+    neg_pos_ratio = float(op.attr("neg_pos_ratio", 3.0))
+    match_type = op.attr("match_type", "per_prediction")
+    loc_w = float(op.attr("loc_loss_weight", 1.0))
+    conf_w = float(op.attr("conf_loss_weight", 1.0))
+    normalize = bool(op.attr("normalize", True))
+
+    n, m, c = conf.shape
+    g_total = gt_box.shape[0]
+    if g_total == 0:
+        # an all-background batch: no positives → no negatives mined →
+        # zero loss (matches the num_neg = ratio * 0 limit below)
+        ctx.set_out(op, "Loss", jnp.zeros((n, 1), jnp.float32))
+        return
+    lengths = ctx.maybe_get(op.input("GTBox")[0] + "@LOD")
+    if lengths is None:
+        lengths = jnp.asarray([g_total], jnp.int32)
+    # pad flat gt to [N, Gmax] (Gmax = total rows: a safe static bound)
+    ends = jnp.cumsum(lengths)
+    seg = jnp.searchsorted(ends, jnp.arange(g_total), side="right")
+    pos = jnp.arange(g_total) - (ends - lengths)[seg]
+    pad_box = jnp.zeros((n, g_total, 4), gt_box.dtype)
+    pad_box = pad_box.at[seg, pos].set(gt_box)
+    pad_lab = jnp.full((n, g_total), background, jnp.int32)
+    pad_lab = pad_lab.at[seg, pos].set(gt_label)
+    gt_valid = jnp.arange(g_total)[None, :] < lengths[:, None]  # [N,Gmax]
+
+    # per-image IoU + greedy matching (invalid gt rows have zero IoU)
+    def match_one(boxes, valid):
+        iou = _iou_matrix(boxes, prior)          # [Gmax, M]
+        iou = jnp.where(valid[:, None], iou, 0.0)
+        cm = _greedy_bipartite(iou)
+        best_val = jnp.max(iou, axis=0)          # per-prior max overlap
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(iou, axis=0)
+            extra = (cm < 0) & (best_val >= overlap_threshold)
+            cm = jnp.where(extra, best_row.astype(jnp.int32), cm)
+        return cm, best_val                       # [M], [M]
+
+    col_match, best_iou = jax.vmap(match_one)(pad_box, gt_valid)  # [N,M]
+    is_pos = col_match >= 0
+
+    # per-prior class targets (matched gt label, else background)
+    midx = jnp.clip(col_match, 0)
+    tgt_label = jnp.where(
+        is_pos, jnp.take_along_axis(pad_lab, midx, axis=1), background)
+
+    logp = jax.nn.log_softmax(conf.astype(jnp.float32), axis=-1)
+    ce = -jnp.take_along_axis(logp, tgt_label[..., None],
+                              axis=-1)[..., 0]              # [N, M]
+
+    # hard negative mining: rank background priors by CE. Unmatched
+    # priors whose best overlap is still >= neg_overlap are EXCLUDED
+    # from the negative pool (mine_hard_examples_op.cc neg_dist
+    # semantics): they straddle an object and must not be pushed
+    # toward background.
+    neg_overlap = float(op.attr("neg_overlap", 0.5))
+    neg_cand = (~is_pos) & (best_iou < neg_overlap)
+    num_pos = jnp.sum(is_pos, axis=1)
+    num_neg = jnp.minimum((num_pos * neg_pos_ratio).astype(jnp.int32),
+                          jnp.sum(neg_cand, axis=1))
+    neg_loss = jnp.where(neg_cand, ce, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    is_neg = rank < num_neg[:, None]
+
+    conf_loss = ce * (is_pos | is_neg).astype(jnp.float32)
+
+    # localization: encode matched gt against priors, smooth-l1 on
+    # positives only
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    mbox = jnp.take_along_axis(pad_box, midx[..., None], axis=1)  # [N,M,4]
+    tw = mbox[..., 2] - mbox[..., 0]
+    th = mbox[..., 3] - mbox[..., 1]
+    tcx = mbox[..., 0] + tw / 2
+    tcy = mbox[..., 1] + th / 2
+    enc = jnp.stack([
+        (tcx - pcx) / pw / pvar[:, 0],
+        (tcy - pcy) / ph / pvar[:, 1],
+        jnp.log(jnp.maximum(tw / pw, 1e-10)) / pvar[:, 2],
+        jnp.log(jnp.maximum(th / ph, 1e-10)) / pvar[:, 3]], axis=-1)
+    diff = loc.astype(jnp.float32) - enc
+    ad = jnp.abs(diff)
+    smooth = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5)
+    loc_loss = jnp.sum(smooth, axis=-1) * is_pos.astype(jnp.float32)
+
+    loss = conf_w * conf_loss + loc_w * loc_loss            # [N, M]
+    per_img = jnp.sum(loss, axis=1, keepdims=True)          # [N, 1]
+    if normalize:
+        denom = jnp.maximum(jnp.sum(num_pos).astype(jnp.float32), 1.0)
+        per_img = per_img / denom
+    ctx.set_out(op, "Loss", per_img)

@@ -25,7 +25,8 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu.distributed import ops as dist_ops
 from paddle_tpu.distributed.membership import (
-    KVServer, KVClient, register_pserver, wait_for_pservers, TrainerLease)
+    KVServer, KVClient, register_pserver, wait_for_pservers,
+    TrainerLease, PS_PREFIX)
 from paddle_tpu.distributed.rpc import RPCClient, VariableServer
 from paddle_tpu.distributed.master import (MasterServer, MasterClient,
                                            TaskQueue)
@@ -407,3 +408,26 @@ def test_stale_incarnation_barrier_and_grads_evicted():
         c_b.shutdown_server()
         c_a.close()
         c_b.close()
+
+
+def test_lease_reclaims_after_stall(kv):
+    """A heartbeat that finds its key expired (stall > TTL) must reclaim
+    the slot atomically rather than vanish; if ANOTHER server claimed it
+    meanwhile, the lease reports `lost` instead of split-braining."""
+    i, lease = register_pserver(kv, 1, "epA:1", ttl=0.4)
+    # simulate a stall: delete the key out from under the lease (as the
+    # TTL sweeper would); the next heartbeat must re-create it
+    kv.delete(PS_PREFIX + "0")
+    time.sleep(0.5)
+    assert kv.get(PS_PREFIX + "0") == "epA:1"
+    assert not lease.lost
+
+    # now a competitor steals the slot during a stall: holder must
+    # detect the loss and stop
+    lease2_val = "epB:1"
+    kv.delete(PS_PREFIX + "0")
+    assert kv.cas(PS_PREFIX + "0", None, lease2_val, ttl=5.0)
+    time.sleep(0.5)
+    assert lease.lost
+    assert kv.get(PS_PREFIX + "0") == "epB:1"
+    lease.revoke()          # no-op on a lost lease's key ownership

@@ -867,6 +867,7 @@ COVERED_ELSEWHERE = {
     "prefetch": "test_distributed.py",
     "split_ids": "test_distributed.py",
     "send_sparse": "test_dist_lookup_table.py",
+    "ssd_loss": "test_ssd.py",
 }
 
 # ops with no one-op test by design; each entry documents why

@@ -1,0 +1,204 @@
+"""SSD training pipeline: multi_box_head + fused ssd_loss (reference
+layers/detection.py:349,567). The loss op is batch-aware over flat-LoD
+ground truth (vmapped greedy matching + hard negative mining), so the
+checks here pin batch-invariance, matching semantics, and an
+end-to-end SSD-lite training run."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import create_lod_tensor
+
+
+def _lod(arr, lens):
+    return create_lod_tensor(arr, [lens])
+
+
+def _run_ssd_loss(loc, conf, gt, labels, lens, priors, pvar=None,
+                  **kw):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    n, m, c = conf.shape
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        lv = fluid.layers.data("loc", [m, 4], append_batch_size=False)
+        cv = fluid.layers.data("conf", [m, c], append_batch_size=False)
+        gv = fluid.layers.data("gt", [4], lod_level=1)
+        yv = fluid.layers.data("lab", [1], dtype="int64", lod_level=1)
+        pb = fluid.layers.data("pb", [m, 4], append_batch_size=False)
+        feeds = {"loc": loc.reshape(n, m, 4)[0:n],
+                 "conf": conf, "gt": _lod(gt, lens),
+                 "lab": _lod(labels.reshape(-1, 1), lens), "pb": priors}
+        args = [lv, cv, gv, yv, pb]
+        if pvar is not None:
+            pv = fluid.layers.data("pv", [m, 4], append_batch_size=False)
+            feeds["pv"] = pvar
+            args.append(pv)
+        loss = fluid.layers.ssd_loss(*args, **kw)
+        exe = fluid.Executor(fluid.CPUPlace())
+        out, = exe.run(main, feed=feeds, fetch_list=[loss])
+    return np.asarray(out)
+
+
+def test_ssd_loss_perfect_predictions_near_floor():
+    """Priors exactly on the gt boxes, loc predicting zero offsets and
+    conf overwhelmingly right → loss ≈ 0; shuffled-conf case is much
+    larger."""
+    priors = np.array([[0.0, 0.0, 0.4, 0.4],
+                       [0.5, 0.5, 0.9, 0.9],
+                       [0.05, 0.55, 0.45, 0.95],
+                       [0.55, 0.05, 0.95, 0.45]], np.float32)
+    gt = priors[:2].copy()               # two gt == first two priors
+    labels = np.array([1, 2], np.int64)
+    lens = [2]
+    m, c = 4, 3
+    loc = np.zeros((1, m, 4), np.float32)    # zero offsets = exact match
+    conf_good = np.full((1, m, c), -8.0, np.float32)
+    conf_good[0, :, 0] = 8.0                  # background everywhere...
+    conf_good[0, 0, :] = [-8, 8, -8]          # ...except the matches
+    conf_good[0, 1, :] = [-8, -8, 8]
+    l_good = _run_ssd_loss(loc, conf_good, gt, labels, lens, priors)
+    assert l_good.shape == (1, 1)
+    assert float(l_good) < 1e-3, l_good
+
+    conf_bad = np.roll(conf_good, 1, axis=2).copy()
+    l_bad = _run_ssd_loss(loc, conf_bad, gt, labels, lens, priors)
+    assert float(l_bad) > 1.0, l_bad
+
+
+def test_ssd_loss_batch_matches_per_image_runs():
+    """Batch-of-2 (different gt counts) rows equal the two single-image
+    runs (normalize=False so denominators don't couple the batch)."""
+    rng = np.random.RandomState(0)
+    m, c = 6, 4
+    priors = np.sort(rng.rand(m, 2, 2), axis=1).reshape(m, 4) \
+        .astype(np.float32)
+    priors = np.concatenate([priors[:, :2] * 0.5,
+                             priors[:, :2] * 0.5 + 0.5], axis=1)
+    loc = rng.randn(2, m, 4).astype(np.float32) * 0.1
+    conf = rng.randn(2, m, c).astype(np.float32)
+    gt1 = np.sort(rng.rand(2, 2, 2), axis=1).reshape(2, 4) \
+        .astype(np.float32)
+    gt2 = np.sort(rng.rand(3, 2, 2), axis=1).reshape(3, 4) \
+        .astype(np.float32)
+    lab1 = np.array([1, 2], np.int64)
+    lab2 = np.array([3, 1, 2], np.int64)
+
+    both = _run_ssd_loss(loc, conf, np.concatenate([gt1, gt2]),
+                         np.concatenate([lab1, lab2]), [2, 3], priors,
+                         normalize=False)
+    one = _run_ssd_loss(loc[:1], conf[:1], gt1, lab1, [2], priors,
+                        normalize=False)
+    two = _run_ssd_loss(loc[1:], conf[1:], gt2, lab2, [3], priors,
+                        normalize=False)
+    np.testing.assert_allclose(both[0], one[0], rtol=1e-5)
+    np.testing.assert_allclose(both[1], two[0], rtol=1e-5)
+
+
+def test_multi_box_head_shapes_consistent():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        img = fluid.layers.data("img", [3, 32, 32])
+        f1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                 stride=4, padding=1)        # 8x8
+        f2 = fluid.layers.conv2d(f1, num_filters=8, filter_size=3,
+                                 stride=2, padding=1)        # 4x4
+        locs, confs, boxes, vars_ = fluid.layers.multi_box_head(
+            inputs=[f1, f2], image=img, base_size=32, num_classes=5,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[4.0, 8.0],
+            max_sizes=[8.0, 16.0], flip=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        iv = np.random.RandomState(1).rand(2, 3, 32, 32) \
+            .astype(np.float32)
+        lv, cv, bv, vv = exe.run(
+            main, feed={"img": iv}, fetch_list=[locs, confs, boxes,
+                                                vars_])
+    lv, cv, bv, vv = map(np.asarray, (lv, cv, bv, vv))
+    # priors per cell: ars [1, 2, 1/2] over 1 min size + 1 max at ar=1 →
+    # 4 per cell; 8*8*4 + 4*4*4 = 320
+    assert bv.shape == (320, 4)
+    assert vv.shape == (320, 4)
+    assert lv.shape == (2, 320, 4)
+    assert cv.shape == (2, 320, 5)
+
+
+def test_ssd_lite_trains():
+    """End-to-end: conv backbone → multi_box_head → ssd_loss; repeated
+    steps on one batch drive the loss down."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        img = fluid.layers.data("img", [3, 32, 32])
+        gt = fluid.layers.data("gt", [4], lod_level=1)
+        lab = fluid.layers.data("lab", [1], dtype="int64", lod_level=1)
+        f1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                 stride=4, padding=1, act="relu")
+        f2 = fluid.layers.conv2d(f1, num_filters=8, filter_size=3,
+                                 stride=2, padding=1, act="relu")
+        locs, confs, boxes, vars_ = fluid.layers.multi_box_head(
+            inputs=[f1, f2], image=img, base_size=32, num_classes=4,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[4.0, 8.0],
+            max_sizes=[8.0, 16.0])
+        loss = fluid.layers.mean(fluid.layers.ssd_loss(
+            locs, confs, gt, lab, boxes, vars_))
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(2)
+        iv = rng.rand(2, 3, 32, 32).astype(np.float32)
+        gtv = np.array([[0.1, 0.1, 0.4, 0.4],
+                        [0.5, 0.5, 0.9, 0.9],
+                        [0.2, 0.6, 0.5, 0.9]], np.float32)
+        labv = np.array([[1], [2], [3]], np.int64)
+        feed = {"img": iv, "gt": _lod(gtv, [2, 1]),
+                "lab": _lod(labv, [2, 1])}
+        losses = []
+        for _ in range(24):
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert all(np.isfinite(losses))
+    # hard-negative mining keeps promoting fresh negatives, so the CE
+    # decays steadily rather than collapsing — assert a solid decrease
+    assert losses[-1] < 0.75 * losses[0], losses
+
+
+def test_ssd_loss_zero_ground_truth():
+    """An all-background batch (zero gt boxes) yields zero loss, not a
+    trace-time crash."""
+    m, c = 4, 3
+    priors = np.array([[0.0, 0.0, 0.4, 0.4],
+                       [0.5, 0.5, 0.9, 0.9],
+                       [0.05, 0.55, 0.45, 0.95],
+                       [0.55, 0.05, 0.95, 0.45]], np.float32)
+    loc = np.zeros((1, m, 4), np.float32)
+    conf = np.zeros((1, m, c), np.float32)
+    out = _run_ssd_loss(loc, conf, np.zeros((0, 4), np.float32),
+                        np.zeros((0,), np.int64), [0], priors)
+    np.testing.assert_allclose(out, np.zeros((1, 1)))
+
+
+def test_ssd_loss_neg_overlap_excludes_near_matches():
+    """An unmatched prior overlapping gt >= neg_overlap must NOT be
+    mined as a hard negative (it straddles an object)."""
+    # two nearly-identical priors on one gt: the first matches, the
+    # second (IoU ~0.9 with gt) must be excluded from negatives, so a
+    # terrible background score there adds NO loss when it is the only
+    # negative candidate above threshold
+    priors = np.array([[0.1, 0.1, 0.5, 0.5],
+                       [0.12, 0.1, 0.52, 0.5],
+                       [0.6, 0.6, 0.9, 0.9]], np.float32)
+    gt = priors[:1].copy()
+    labels = np.array([1], np.int64)
+    loc = np.zeros((1, 3, 4), np.float32)
+    conf = np.full((1, 3, 2), 0.0, np.float32)
+    conf[0, 0] = [-8, 8]        # matched prior: confidently class 1
+    conf[0, 1] = [-8, 8]        # near-match prior: "wrong" for bg...
+    conf[0, 2] = [8, -8]        # far prior: confidently background
+    out = _run_ssd_loss(loc, conf, gt, labels, [1], priors,
+                        neg_overlap=0.5, normalize=False)
+    # prior 1 excluded from negatives; prior 2's bg CE ~0; match CE ~0;
+    # loc loss 0 → near-zero total. Without the exclusion prior 1's
+    # CE(bg | logits [-8, 8]) = 16 would dominate.
+    assert float(out) < 0.1, out
