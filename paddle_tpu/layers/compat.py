@@ -307,14 +307,19 @@ def detection_output(loc, scores, prior_box, prior_box_var,
                      background_label=0, nms_threshold=0.3, nms_top_k=400,
                      keep_top_k=200, score_threshold=0.01,
                      nms_eta=1.0):
+    """Reference composition (layers/detection.py detection_output):
+    softmax the raw class scores [N, M, C], decode predicted offsets
+    against priors, transpose scores to [N, C, M], then multiclass
+    NMS."""
     if nms_eta != 1.0:
         raise NotImplementedError(
             "detection_output: adaptive nms_eta != 1.0 is not implemented")
-    """Reference composition (layers/detection.py detection_output):
-    decode predicted offsets against priors, then multiclass NMS."""
+    from .nn import softmax
+    from .tensor import transpose
+    probs = transpose(softmax(scores), perm=[0, 2, 1])
     decoded = box_coder(prior_box, prior_box_var, loc,
                         code_type="decode_center_size")
-    return multiclass_nms(decoded, scores,
+    return multiclass_nms(decoded, probs,
                           score_threshold=score_threshold,
                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
                           nms_threshold=nms_threshold,

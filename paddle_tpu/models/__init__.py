@@ -3,4 +3,4 @@ models (benchmark/fluid/{mnist,resnet,vgg,machine_translation,
 stacked_dynamic_lstm}.py + tests/unittests/transformer_model.py), built
 TPU-first with the paddle_tpu layers DSL."""
 
-from . import mlp, resnet, vgg  # noqa: F401
+from . import mlp, resnet, ssd, vgg  # noqa: F401

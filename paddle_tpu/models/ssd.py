@@ -1,0 +1,67 @@
+"""SSD object detector — the detection model family of the reference era
+(reference layers/detection.py multi_box_head/ssd_loss; the SSD ops are
+operators/{prior_box,box_coder,bipartite_match,mine_hard_examples,
+multiclass_nms}_op.cc).
+
+A compact VGG-ish backbone feeding two detection feature maps; training
+uses the fused batch-aware ``ssd_loss`` op, inference decodes + NMSes
+with ``detection_output``.
+"""
+
+import paddle_tpu as fluid
+
+
+def _backbone(image):
+    """Two detection feature maps at strides 8 and 16."""
+    x = image
+    for width in (16, 32):
+        x = fluid.layers.conv2d(x, num_filters=width, filter_size=3,
+                                padding=1, act="relu")
+        x = fluid.layers.pool2d(x, pool_size=2, pool_stride=2)
+    f1 = fluid.layers.conv2d(x, num_filters=64, filter_size=3,
+                             padding=1, stride=2, act="relu")
+    f2 = fluid.layers.conv2d(f1, num_filters=64, filter_size=3,
+                             padding=1, stride=2, act="relu")
+    return f1, f2
+
+
+def _head(image, image_shape, num_classes):
+    """Shared backbone + multi_box_head config: train and infer nets MUST
+    agree on the prior grid and conv shapes or a trained checkpoint
+    stops matching the inference net."""
+    f1, f2 = _backbone(image)
+    return fluid.layers.multi_box_head(
+        inputs=[f1, f2], image=image, base_size=image_shape[-1],
+        num_classes=num_classes, aspect_ratios=[[2.0], [2.0, 3.0]],
+        min_sizes=[image_shape[-1] * 0.2, image_shape[-1] * 0.5],
+        max_sizes=[image_shape[-1] * 0.5, image_shape[-1] * 0.9])
+
+
+def build_ssd_train_net(image_shape=(3, 64, 64), num_classes=5,
+                        learning_rate=1e-3):
+    """Returns (image, gt_box, gt_label, loss). gt_box/gt_label are
+    flat-LoD ([Ng, 4] / [Ng, 1] with per-image lengths)."""
+    image = fluid.layers.data("image", list(image_shape))
+    gt_box = fluid.layers.data("gt_box", [4], lod_level=1)
+    gt_label = fluid.layers.data("gt_label", [1], dtype="int64",
+                                 lod_level=1)
+    locs, confs, boxes, vars_ = _head(image, image_shape, num_classes)
+    loss = fluid.layers.mean(fluid.layers.ssd_loss(
+        locs, confs, gt_box, gt_label, boxes, vars_))
+    fluid.optimizer.Adam(learning_rate=learning_rate).minimize(loss)
+    return image, gt_box, gt_label, loss
+
+
+def build_ssd_infer_net(image_shape=(3, 64, 64), num_classes=5,
+                        nms_threshold=0.45, score_threshold=0.01,
+                        keep_top_k=50):
+    """Returns (image, detections [keep_top_k, 6] rows of
+    (label, score, x1, y1, x2, y2), -1-padded)."""
+    image = fluid.layers.data("image", list(image_shape))
+    locs, confs, boxes, vars_ = _head(image, image_shape, num_classes)
+    # detection_output softmaxes the raw [N, M, C] scores itself
+    # (reference detection_output contract)
+    dets = fluid.layers.detection_output(
+        locs, confs, boxes, vars_, nms_threshold=nms_threshold,
+        score_threshold=score_threshold, keep_top_k=keep_top_k)
+    return image, dets

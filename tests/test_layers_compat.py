@@ -176,7 +176,9 @@ def test_detection_wrappers():
 
 def test_detection_output_and_map():
     loc = fluid.layers.data("loc", [4, 4], append_batch_size=False)
-    conf = fluid.layers.data("conf", [1, 2, 4], append_batch_size=False)
+    # reference contract: raw scores [N, M, C]; detection_output
+    # softmaxes + transposes internally
+    conf = fluid.layers.data("conf", [1, 4, 2], append_batch_size=False)
     pb = fluid.layers.data("pb", [4, 4], append_batch_size=False)
     pbv = fluid.layers.data("pbv", [4, 4], append_batch_size=False)
     out = fluid.layers.detection_output(loc, conf, pb, pbv)
@@ -186,7 +188,7 @@ def test_detection_output_and_map():
     rng = np.random.RandomState(0)
     vals = _run([out, m], {
         "loc": np.zeros((4, 4), np.float32),
-        "conf": rng.rand(1, 2, 4).astype(np.float32),
+        "conf": rng.rand(1, 4, 2).astype(np.float32),
         "pb": np.abs(rng.rand(4, 4)).astype(np.float32),
         "pbv": np.full((4, 4), 0.1, np.float32),
         "det": np.array([[0, 0.9, 0, 0, 10, 10]], np.float32),

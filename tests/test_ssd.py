@@ -202,3 +202,44 @@ def test_ssd_loss_neg_overlap_excludes_near_matches():
     # loc loss 0 → near-zero total. Without the exclusion prior 1's
     # CE(bg | logits [-8, 8]) = 16 would dominate.
     assert float(out) < 0.1, out
+
+
+def test_ssd_model_zoo_train_and_infer():
+    """models/ssd.py: the zoo SSD trains (loss decreases on a fixed
+    batch) and its inference net emits -1-padded [keep_top_k, 6]
+    detections."""
+    from paddle_tpu.models import ssd as ssd_zoo
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        image, gt_box, gt_label, loss = ssd_zoo.build_ssd_train_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(5)
+        feed = {"image": rng.rand(2, 3, 64, 64).astype(np.float32),
+                "gt_box": _lod(np.array(
+                    [[0.1, 0.1, 0.5, 0.5], [0.4, 0.4, 0.9, 0.9],
+                     [0.2, 0.2, 0.6, 0.8]], np.float32), [2, 1]),
+                "gt_label": _lod(np.array([[1], [2], [3]], np.int64),
+                                 [2, 1])}
+        losses = []
+        for _ in range(10):
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = fluid.Scope()
+    with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2):
+        image, dets = ssd_zoo.build_ssd_infer_net(keep_top_k=20)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        out, = exe.run(main2, feed={
+            "image": np.random.RandomState(6)
+            .rand(1, 3, 64, 64).astype(np.float32)}, fetch_list=[dets])
+    out = np.asarray(out)
+    assert out.shape[-1] == 6
+    # rows are either real detections or -1 padding
+    assert ((out[..., 0] >= 0) | (out[..., 0] == -1)).all()
