@@ -123,9 +123,26 @@ class Executor:
         # (e.g. an eval recv) must not advance a training program's
         # round sequence. Entry: program -> [seq, program_nonce].
         self._run_seqs = weakref.WeakKeyDictionary()
-        # incarnation nonce: a RESTARTED trainer's seq restarts at 0 —
-        # servers evict pending grads from the dead incarnation by it
-        self._incarnation = uuid.uuid4().hex[:8]
+        # incarnation id: a RESTARTED trainer's seq restarts at 0 —
+        # servers evict pending grads from the dead incarnation by it.
+        # The 16-hex-digit time_ns prefix ORDERS incarnations, so a
+        # server can drop a dead incarnation's straggler (its epoch is
+        # below the replacement's) instead of letting it evict the live
+        # replacement's pending state; the nonce suffix breaks ties.
+        import time as _time
+        self._incarnation = ("%016x" % _time.time_ns()
+                             + uuid.uuid4().hex[:8])
+
+    def _reincarnate(self, min_epoch):
+        """A pserver judged our incarnation stale (possible after an
+        elastic reschedule onto a host whose clock is behind the old
+        one): mint a new incarnation with an epoch past the server's
+        max, so retried sends are accepted instead of deadlocking."""
+        import time as _time
+        import uuid
+        epoch = max(_time.time_ns(), int(min_epoch) + 1)
+        self._incarnation = "%016x" % epoch + uuid.uuid4().hex[:8]
+        return self._incarnation
 
     # ------------------------------------------------------------------
     def close(self):
@@ -315,10 +332,20 @@ class Executor:
                     run_ids.add(id(o))
                     needed.update(n for ns in o.inputs.values()
                                   for n in ns)
+            # RNG-stateful slice ops must NOT re-run inside the grad
+            # trace: the re-traced draw would diverge from the ids the
+            # prefetch actually fetched, mispairing rows and gradients.
+            # Track which ops drew from the stream and bind their eager
+            # outputs as constants in the trace instead.
+            rng_ops = set()
             for o in pre:
                 if id(o) in run_ids:
+                    drawn = counter[0]
                     _lower_op(ctx, o)
-            self._lower_with_grad(ctx, ops, bwd_idx, program, block)
+                    if counter[0] != drawn:
+                        rng_ops.add(id(o))
+            self._lower_with_grad(ctx, ops, bwd_idx, program, block,
+                                  skip_op_ids=rng_ops)
 
         for n in persistable:
             if n in env:
@@ -600,7 +627,8 @@ class Executor:
                 marker.attr("target_names") or [])
 
     @staticmethod
-    def _lower_with_grad(ctx, ops, bwd_idx, program, block):
+    def _lower_with_grad(ctx, ops, bwd_idx, program, block,
+                         skip_op_ids=frozenset()):
         """Trace forward ops under value_and_grad, bind param@GRAD vars, then
         trace the remaining (optimizer) ops.
 
@@ -644,6 +672,12 @@ class Executor:
                 if registry.is_host_op(op.type) and any(
                         n in wrt_set for ns in op.outputs.values()
                         for n in ns):
+                    continue
+                # RNG-stateful ops already run eagerly (prefetch id
+                # slice): their concrete outputs sit in base_env; a
+                # re-traced draw would produce DIFFERENT ids than the
+                # rows the prefetch fetched
+                if id(op) in skip_op_ids:
                     continue
                 _lower_op(fctx, op)
             # scalar objective: mean-reduce each target (loss is already

@@ -19,6 +19,7 @@ import json
 import socketserver
 import threading
 import time
+import uuid
 
 from .rpc import _send_msg, _recv_msg
 
@@ -123,6 +124,17 @@ class KVServer:
             with self._lock:
                 self._data.pop(name, None)
             _send_msg(sock, "OK")
+        elif op == "CAD":
+            # compare-and-delete: remove only while WE still own the key,
+            # so a holder that lost its slot cannot delete the new
+            # owner's registration (etcd DeleteIfValue semantics)
+            with self._lock:
+                ent = self._alive(name)
+                if ent is not None and ent[0] == body.get("old"):
+                    self._data.pop(name, None)
+                    _send_msg(sock, "OK")
+                else:
+                    _send_msg(sock, "FAIL", name)
         elif op == "LIST":
             with self._lock:
                 now = time.time()
@@ -189,6 +201,11 @@ class KVClient:
     def delete(self, key):
         self._call("DEL", key)
 
+    def cad(self, key, old):
+        """Compare-and-delete: remove key only if it still holds `old`.
+        Returns True if the key was deleted."""
+        return self._call("CAD", key, {"old": old})[0] == "OK"
+
     def list(self, prefix):
         _, _, payload = self._call("LIST", prefix)
         return json.loads(payload.decode())
@@ -251,10 +268,22 @@ class _Lease:
                 return
 
     def revoke(self):
-        """Stop heartbeating and delete the key (graceful leave)."""
+        """Stop heartbeating and release the key (graceful leave).
+
+        Uses compare-and-delete keyed on our own value: if the lease was
+        lost and another holder now owns the key, the delete is a no-op —
+        a departing loser must not free the NEW owner's slot."""
         self._stop.set()
+        # join BEFORE deleting: a heartbeat mid-iteration could otherwise
+        # re-create the key with its reclaim CAS right after our delete,
+        # leaving the departed member registered for up to one TTL. The
+        # loop exits within ttl/3 of _stop.set(); if the thread is wedged
+        # in a slow KV call, skip the delete and let the TTL expire it.
+        self._thread.join(timeout=self.ttl * 2 + 1.0)
+        if self.lost or self._thread.is_alive():
+            return
         try:
-            self.kv.delete(self.key)
+            self.kv.cad(self.key, self.value)
         except (ConnectionError, OSError):
             pass
 
@@ -296,8 +325,13 @@ class TrainerLease:
     def __init__(self, kv, trainer_id, ttl=1.0):
         self.trainer_id = str(trainer_id)
         self.key = TRAINER_PREFIX + self.trainer_id
-        kv.put(self.key, "alive", ttl=ttl)
-        self._lease = _Lease(kv, self.key, ttl)
+        # Unique per-incarnation value so the LEAS expect-guard can tell
+        # a stalled old incarnation from its replacement: with a shared
+        # "alive" value a zombie's heartbeat would extend the usurper's
+        # lease and neither side would ever see `lost` (split-brain).
+        incarnation = "alive:" + uuid.uuid4().hex
+        kv.put(self.key, incarnation, ttl=ttl)
+        self._lease = _Lease(kv, self.key, ttl, value=incarnation)
 
     @staticmethod
     def live_trainers(kv):

@@ -12,7 +12,7 @@ import numpy as np
 
 from ..core.registry import register
 from ..core.selected_rows import SelectedRows
-from .rpc import RPCClient
+from .rpc import RPCClient, StaleIncarnationError
 
 
 import threading
@@ -76,26 +76,84 @@ def _round_tag(ctx, op):
                             getattr(ctx, "incarnation", "0"), seq)
 
 
+def _bump_incarnation(ctx, exc):
+    """A server judged this trainer's incarnation stale (clock skew
+    after an elastic reschedule can make a LIVE replacement look like a
+    dead straggler — rpc.StaleIncarnationError). Re-incarnate the
+    executor past the server's max epoch and rebuild ctx.incarnation,
+    preserving the per-program nonce suffix."""
+    ex = getattr(ctx, "executor", None)
+    old = getattr(ctx, "incarnation", "")
+    exec_inc = getattr(ex, "_incarnation", "")
+    if not exec_inc or not old.startswith(exec_inc):
+        raise exc          # no executor-owned incarnation to renew
+    program_nonce = old[len(exec_inc):]
+    ctx.incarnation = ex._reincarnate(exc.max_epoch) + program_nonce
+
+
+def _retrying_round(ctx, op, body):
+    """Run `body(tag)` with stale-incarnation recovery.
+
+    Re-incarnating changes the round tag, and the server's idempotency
+    bookkeeping is keyed by it — so a retry must (a) replay EVERY
+    tagged send body of this round, not just the failing op (an earlier
+    op's pending grads under the old tag are evicted by the first
+    new-tag message and would otherwise be silently lost), and (b) skip
+    endpoints whose round barrier already completed (their round closed
+    WITH our old-tag grads applied; a new-tag resend would bypass the
+    seq dedup and double-apply). Bodies honor (b) via
+    ``ctx.round_closed_eps``. Both records live on ctx, which is fresh
+    per Executor.run, i.e. per round. Bounded attempts: several servers
+    may each hold a higher max epoch, needing one bump per offender."""
+    journal = getattr(ctx, "_round_journal", None)
+    if journal is None:
+        journal = ctx._round_journal = []
+        ctx.round_closed_eps = set()
+    journal.append(body)
+    replay_from = len(journal) - 1       # first attempt: just this op
+    for _ in range(5):
+        try:
+            tag = _round_tag(ctx, op)
+            for b in journal[replay_from:]:
+                b(tag)
+            return
+        except StaleIncarnationError as exc:
+            _bump_incarnation(ctx, exc)
+            replay_from = 0              # new tag: replay the full round
+    raise RuntimeError(
+        "send round still judged stale after 5 re-incarnations")
+
+
 @register("send", host=True)
 def _send(ctx, op):
     """Push each input var to its endpoint (send_op.cc / send_vars)."""
     eps = op.attr("epmap") or op.attr("endpoints") or []
     names = op.input("X")
-    tag = _round_tag(ctx, op)
-    for i, name in enumerate(names):
-        ep = eps[i % len(eps)]
-        val = ctx.get(name)
-        if not isinstance(val, SelectedRows):
-            val = np.asarray(val)
-        _client(ep).send_var(op.attr("send_names", names)[i]
-                             if op.attr("send_names") else name, val,
-                             tag=tag)
-    # barrier EVERY transpiled endpoint, not just the ones that received
-    # a dense grad: a server owning only a sparse-table shard still needs
-    # this trainer's round signal (listen_and_serv fan_in semantics)
-    if op.attr("sync", True):
-        for ep in set(op.attr("endpoints") or eps):
-            _client(ep).barrier(tag=tag)
+
+    def round_body(tag):
+        closed = getattr(ctx, "round_closed_eps", set())
+        for i, name in enumerate(names):
+            ep = eps[i % len(eps)]
+            if ep in closed:
+                continue    # that server's round already applied these
+            val = ctx.get(name)
+            if not isinstance(val, SelectedRows):
+                val = np.asarray(val)
+            _client(ep).send_var(op.attr("send_names", names)[i]
+                                 if op.attr("send_names") else name, val,
+                                 tag=tag)
+        # barrier EVERY transpiled endpoint, not just the ones that
+        # received a dense grad: a server owning only a sparse-table
+        # shard still needs this trainer's round signal
+        # (listen_and_serv fan_in semantics)
+        if op.attr("sync", True):
+            for ep in set(op.attr("endpoints") or eps):
+                if ep in closed:
+                    continue
+                _client(ep).barrier(tag=tag)
+                closed.add(ep)
+
+    _retrying_round(ctx, op, round_body)
 
 
 @register("send_barrier", host=True)
@@ -138,14 +196,18 @@ def _send_sparse(ctx, op):
     acc = np.zeros((len(uniq), rows.shape[1]), rows.dtype)
     np.add.at(acc, inv, rows)
     n = max(1, len(eps))
-    tag = _round_tag(ctx, op)
-    for i, ep in enumerate(eps):
-        mask = (uniq % n) == i
-        if not mask.any():
-            continue
-        _client(ep).send_var(
-            grad_name, SelectedRows(uniq[mask], acc[mask], height),
-            tag=tag)
+
+    def round_body(tag):
+        closed = getattr(ctx, "round_closed_eps", set())
+        for i, ep in enumerate(eps):
+            mask = (uniq % n) == i
+            if not mask.any() or ep in closed:
+                continue
+            _client(ep).send_var(
+                grad_name, SelectedRows(uniq[mask], acc[mask], height),
+                tag=tag)
+
+    _retrying_round(ctx, op, round_body)
 
 
 @register("recv", host=True)

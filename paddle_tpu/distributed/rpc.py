@@ -11,6 +11,7 @@ arbitrary-objects, so a malicious peer can't execute code via the
 deserializer.
 """
 
+import itertools
 import json
 import os
 import socket
@@ -157,6 +158,33 @@ def _parse_tag(tag):
     return None, None
 
 
+class StaleIncarnationError(RuntimeError):
+    """A server has seen a NEWER incarnation of this trainer id, so it
+    rejected our message. Normally that means we are a dead
+    incarnation's delayed retry — but after an elastic reschedule onto
+    a host whose clock is behind, a LIVE replacement can look stale
+    too. The error carries the server's max epoch so the sender can
+    re-incarnate past it and retry instead of deadlocking the round."""
+
+    def __init__(self, max_epoch):
+        super().__init__(
+            "server knows a newer incarnation (epoch %d) of this "
+            "trainer — re-incarnate past it and retry" % max_epoch)
+        self.max_epoch = max_epoch
+
+
+def _inc_epoch(pref):
+    """Incarnation ordering: 't<id>:i<16-hex-epoch><nonce>' → epoch int,
+    or None for legacy/handmade incarnation ids (no ordering known)."""
+    inc = pref.split(":i", 1)
+    if len(inc) != 2 or len(inc[1]) < 16:
+        return None
+    try:
+        return int(inc[1][:16], 16)
+    except ValueError:
+        return None
+
+
 class VariableServer:
     """Parameter-server process half (listen_and_serv_op.cc semantics):
     holds a scope of variables; SEND accumulates gradients, GET serves
@@ -181,6 +209,8 @@ class VariableServer:
         self._barrier_count = 0
         self._barr_seen = set()      # tags counted toward THIS round
         self._applied = {}           # "t<id>:i<inc>" -> last applied seq
+        self._untagged_seq = itertools.count()
+        self._max_epoch = {}         # "t<id>" -> newest incarnation epoch
         self._round = 0
         self._shutdown = threading.Event()
         outer = self
@@ -239,6 +269,12 @@ class VariableServer:
             pref, seq = _parse_tag(tag)
             if self.sync:
                 with self._lock:
+                    stale = (self._stale_epoch(pref)
+                             if pref is not None else None)
+                    if stale is not None:
+                        _send_msg(sock, "STLE", name, json.dumps(
+                            {"max_epoch": stale}).encode())
+                        return
                     if pref is not None and \
                             seq <= self._applied.get(pref, -1):
                         _send_msg(sock, "OK")   # round already applied
@@ -246,8 +282,12 @@ class VariableServer:
                     if pref is not None:
                         self._evict_stale_incarnation(pref)
                     slot = self.grads.setdefault(name, {})
+                    # untagged sends get a monotonic key, never reused:
+                    # len(slot) could collide with a live key after an
+                    # eviction shrank the dict, silently replacing a
+                    # pending grad that should accumulate
                     slot[tag if tag is not None
-                         else "#%d" % len(slot)] = value
+                         else "#%d" % next(self._untagged_seq)] = value
             else:
                 # Async SGD (ParameterServer2.h async paths /
                 # async_update.md): apply this gradient immediately under
@@ -301,6 +341,29 @@ class VariableServer:
         else:
             _send_msg(sock, "ERR", "unknown op %s" % op)
 
+    def _stale_epoch(self, pref):
+        """Under the lock. Non-None → REJECT this message with STLE: its
+        incarnation is OLDER than one already seen for the trainer id,
+        i.e. it is (almost always) a dead incarnation's straggler.
+        Without this gate a delayed retry from the dead incarnation
+        would pass the _applied check (its entry may be pruned) and then
+        evict the LIVE replacement's pending grads via
+        _evict_stale_incarnation. The returned max epoch travels back in
+        the STLE reply so that the rare LIVE sender judged stale (clock
+        skew after an elastic reschedule) can re-incarnate past it and
+        retry — a silent drop would deadlock the whole round. Legacy
+        unordered incarnation ids return None and keep the old eviction
+        rules."""
+        epoch = _inc_epoch(pref)
+        if epoch is None:
+            return None
+        tid = pref.split(":", 1)[0]
+        cur = self._max_epoch.get(tid)
+        if cur is not None and epoch < cur:
+            return cur
+        self._max_epoch[tid] = epoch
+        return None
+
     def _evict_stale_incarnation(self, pref):
         """Drop EVERYTHING a dead incarnation of this trainer left
         behind: pending grads under every name, and its counted barrier
@@ -322,6 +385,19 @@ class VariableServer:
             self._barr_seen -= dead_barrs
             self._barrier_count = max(
                 0, self._barrier_count - len(dead_barrs))
+        # drop the dead incarnations' applied-round history too, or a
+        # long-lived pserver under elastic churn grows _applied forever.
+        # Only prune entries PROVABLY older by epoch: for those, the
+        # epoch gate already rejects any late retry, so the history is
+        # dead weight. A legacy (unordered) entry must survive — it is
+        # the only thing standing between a delayed applied-round retry
+        # and this eviction path.
+        caller_epoch = _inc_epoch(pref)
+        if caller_epoch is not None:
+            for k in [k for k in self._applied if stale(k + ":")]:
+                ke = _inc_epoch(k)
+                if ke is not None and ke < caller_epoch:
+                    del self._applied[k]
 
     def _barrier(self, sock, tag=None):
         """Round barrier: after fan_in SENDs+BARRs, run the optimize step
@@ -335,6 +411,11 @@ class VariableServer:
         retries exactly-once per round."""
         pref, seq = _parse_tag(tag)
         with self._round_cv:
+            stale = self._stale_epoch(pref) if pref is not None else None
+            if stale is not None:
+                _send_msg(sock, "STLE", tag or "", json.dumps(
+                    {"max_epoch": stale}).encode())
+                return
             if pref is not None and seq <= self._applied.get(pref, -1):
                 _send_msg(sock, "OK")   # this round already completed
                 return
@@ -471,7 +552,14 @@ class RPCClient:
         same tag replaces the pending grad server-side (see SEND)."""
         wire = name if tag is None else "%s||%s" % (name, tag)
         _send_msg(self._sock, "SEND", wire, _serialize_parts(value))
-        assert _recv_msg(self._sock)[0] == "OK"
+        self._expect_ok()
+
+    def _expect_ok(self):
+        op, _, payload = _recv_msg(self._sock)
+        if op == "STLE":
+            raise StaleIncarnationError(
+                json.loads(payload.decode())["max_epoch"])
+        assert op == "OK", op
 
     def get_var(self, name):
         _send_msg(self._sock, "GET", name)
@@ -498,7 +586,7 @@ class RPCClient:
         # arrive, which can take arbitrarily long (slow peers, compiles)
         self._sock.settimeout(None)
         try:
-            assert _recv_msg(self._sock)[0] == "OK"
+            self._expect_ok()
         finally:
             self._sock.settimeout(self._timeout)
 

@@ -27,7 +27,8 @@ from paddle_tpu.distributed import ops as dist_ops
 from paddle_tpu.distributed.membership import (
     KVServer, KVClient, register_pserver, wait_for_pservers,
     TrainerLease, PS_PREFIX)
-from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+from paddle_tpu.distributed.rpc import (RPCClient, VariableServer,
+                                        StaleIncarnationError)
 from paddle_tpu.distributed.master import (MasterServer, MasterClient,
                                            TaskQueue)
 
@@ -410,6 +411,180 @@ def test_stale_incarnation_barrier_and_grads_evicted():
         c_b.close()
 
 
+def test_dead_incarnation_straggler_dropped_by_epoch_gate():
+    """A delayed message from a DEAD incarnation (older time_ns epoch,
+    the Executor's incarnation format) must be dropped outright — even
+    when its applied-round history was pruned — so it can neither evict
+    the live replacement's pending grads nor contribute its own."""
+    applied = []
+
+    def opt(store, grads):
+        applied.append({k: np.asarray(v).copy()
+                        for k, v in grads.items()})
+
+    inc_old = "%016x" % 1000 + "aaaaaaaa"   # epoch 1000
+    inc_new = "%016x" % 2000 + "bbbbbbbb"   # epoch 2000 (replacement)
+    server = VariableServer(fan_in=1, optimize_fn=opt).start()
+    cli = RPCClient("127.0.0.1:%d" % server.port)
+    g = np.ones((2,), np.float32)
+    try:
+        # replacement incarnation sends its grad first
+        cli.send_var("w@GRAD", 2 * g, tag="t0:i%s:s0" % inc_new)
+        # dead incarnation's straggler arrives late: rejected with STLE
+        # (NOT silently acked — a live-but-skewed sender must find out),
+        # and the replacement's pending grad must survive untouched
+        with pytest.raises(StaleIncarnationError) as exc:
+            cli.send_var("w@GRAD", 100 * g, tag="t0:i%s:s7" % inc_old)
+        assert exc.value.max_epoch == 2000
+        with server._lock:
+            assert len(server.grads["w@GRAD"]) == 1
+        # a straggler BARR is rejected too and must not count
+        with pytest.raises(StaleIncarnationError):
+            cli.barrier(tag="t0:i%s:s7" % inc_old)
+        assert len(applied) == 0
+        cli.barrier(tag="t0:i%s:s0" % inc_new)
+        assert len(applied) == 1
+        np.testing.assert_allclose(applied[0]["w@GRAD"], 2 * g)
+    finally:
+        cli.shutdown_server()
+        cli.close()
+
+
+def test_stale_live_trainer_reincarnates_and_recovers():
+    """The OTHER side of the epoch gate: a LIVE trainer judged stale
+    (rescheduled onto a host whose clock is behind) must not deadlock —
+    the send op re-incarnates past the server's max epoch and retries
+    the whole round, which then applies its gradient."""
+    import types
+    from paddle_tpu.distributed import ops as dops
+    applied = []
+
+    def opt(store, grads):
+        applied.append({k: np.asarray(v).copy()
+                        for k, v in grads.items()})
+
+    server = VariableServer(fan_in=1, optimize_fn=opt).start()
+    ep = "127.0.0.1:%d" % server.port
+    seed = RPCClient(ep)
+    g = np.ones((2,), np.float32)
+    try:
+        # server has already seen epoch 2000 for trainer 0
+        seed.send_var("w@GRAD", 9 * g,
+                      tag="t0:i%s:s0" % ("%016x" % 2000 + "bbbbbbbb"))
+        # live trainer restarts with a BEHIND clock: epoch 1000
+        ex = fluid.Executor(fluid.CPUPlace())
+        ex._incarnation = "%016x" % 1000 + "aaaaaaaa"
+        ctx = types.SimpleNamespace(
+            executor=ex, incarnation=ex._incarnation + "pn", run_seq=0,
+            env={"w@GRAD": 3 * g}, get=lambda n: 3 * g)
+
+        class _Op:
+            def attr(self, name, default=None):
+                return {"trainer_id": 0, "endpoints": [ep],
+                        "sync": True}.get(name, default)
+
+            def input(self, k):
+                return ["w@GRAD"]
+
+        dops._send(ctx, _Op())
+        assert len(applied) == 1
+        np.testing.assert_allclose(applied[0]["w@GRAD"], 3 * g)
+        # executor minted an incarnation past the server's max epoch
+        assert int(ex._incarnation[:16], 16) > 2000
+        assert ctx.incarnation.endswith("pn")
+    finally:
+        seed.shutdown_server()
+        seed.close()
+        dops.reset_clients()
+
+
+def test_reincarnation_replays_whole_round_and_skips_closed():
+    """Re-incarnating mid-round changes the tag, so (a) EARLIER tagged
+    sends of the same round must be replayed (the first new-tag message
+    evicts their old-tag pending grads), and (b) endpoints whose round
+    barrier already completed must be skipped (their round applied the
+    old-tag grads; a new-tag resend would double-apply)."""
+    import types
+    from paddle_tpu.distributed import ops as dops
+    applied = []
+
+    def opt(store, grads):
+        applied.append({k: np.asarray(v).copy()
+                        for k, v in grads.items()})
+
+    server = VariableServer(fan_in=1, optimize_fn=opt).start()
+    ep = "127.0.0.1:%d" % server.port
+    cli = RPCClient(ep)
+    g = np.ones((2,), np.float32)
+    try:
+        ex = fluid.Executor(fluid.CPUPlace())
+        ex._incarnation = "%016x" % 1000 + "aaaaaaaa"
+        env = {"w@GRAD": 3 * g,
+               "ids0": np.array([1, 3], np.int64),
+               "emb@GRAD@RAW": np.ones((2, 2), np.float32)}
+        ctx = types.SimpleNamespace(
+            executor=ex, incarnation=ex._incarnation + "pn", run_seq=0,
+            env=env, get=lambda n: env[n])
+
+        class _DenseOp:
+            def attr(self, name, default=None):
+                return {"trainer_id": 0, "endpoints": [ep],
+                        "sync": False}.get(name, default)
+
+            def input(self, k):
+                return ["w@GRAD"]
+
+        class _SparseOp:
+            def attr(self, name, default=None):
+                return {"trainer_id": 0, "endpoints": [ep],
+                        "grad_name": "emb@GRAD", "height": 10
+                        }.get(name, default)
+
+            def input(self, k):
+                return {"Ids": ["ids0"], "Grads": ["emb@GRAD@RAW"]}[k]
+
+        # dense send lands first (epoch 1000 becomes the max)
+        dops._send(ctx, _DenseOp())
+        # a dead predecessor's HIGHER-epoch straggler now arrives: it
+        # bumps max to 2000 and evicts the live trainer's pending dense
+        # grad (different incarnation, same trainer id)
+        cli.send_var("x@GRAD", 9 * g,
+                     tag="t0:i%s:s0" % ("%016x" % 2000 + "bbbbbbbb"))
+        with server._lock:
+            assert "w@GRAD" not in server.grads \
+                or not server.grads["w@GRAD"]
+        # the sparse send is now judged stale → re-incarnate → the
+        # WHOLE round (dense + sparse) replays under the new tag
+        dops._send_sparse(ctx, _SparseOp())
+        cli.barrier()        # untagged trailing barrier closes the round
+        assert len(applied) == 1
+        assert "w@GRAD" in applied[0], applied[0].keys()   # replayed
+        assert "emb@GRAD" in applied[0]
+        assert "x@GRAD" not in applied[0]   # dead straggler evicted
+        np.testing.assert_allclose(applied[0]["w@GRAD"], 3 * g)
+
+        # (b) an endpoint whose barrier completed is skipped on replay:
+        # journal replay must not re-send or re-barrier a closed server
+        ctx2 = types.SimpleNamespace(
+            executor=ex, incarnation=ex._incarnation + "pn", run_seq=1,
+            env=env, get=lambda n: env[n],
+            _round_journal=[], round_closed_eps={ep})
+
+        class _SyncOp(_DenseOp):
+            def attr(self, name, default=None):
+                return {"trainer_id": 0, "endpoints": [ep],
+                        "sync": True}.get(name, default)
+
+        dops._send(ctx2, _SyncOp())
+        assert len(applied) == 1      # nothing sent, no round fired
+        with server._lock:
+            assert not server.grads.get("w@GRAD")
+    finally:
+        cli.shutdown_server()
+        cli.close()
+        dops.reset_clients()
+
+
 def test_lease_reclaims_after_stall(kv):
     """A heartbeat that finds its key expired (stall > TTL) must reclaim
     the slot atomically rather than vanish; if ANOTHER server claimed it
@@ -430,4 +605,45 @@ def test_lease_reclaims_after_stall(kv):
     time.sleep(0.5)
     assert lease.lost
     assert kv.get(PS_PREFIX + "0") == "epB:1"
-    lease.revoke()          # no-op on a lost lease's key ownership
+    lease.revoke()
+    # the loser's graceful leave must NOT free the new owner's slot
+    assert kv.get(PS_PREFIX + "0") == "epB:1"
+
+
+def test_revoke_is_compare_and_delete(kv):
+    """Even when `lost` was never observed (heartbeat thread raced or
+    died), revoke only deletes the key if it still holds OUR value."""
+    i, lease = register_pserver(kv, 1, "epA:1", ttl=0.4)
+    lease._stop.set()                    # freeze the heartbeat thread
+    lease._thread.join(timeout=2.0)
+    kv.put(PS_PREFIX + "0", "epB:1", ttl=5.0)   # usurper took the slot
+    lease.revoke()
+    assert kv.get(PS_PREFIX + "0") == "epB:1"
+    # and cad() itself: deletes only on a value match
+    kv.put("/x", "v1")
+    assert not kv.cad("/x", "other")
+    assert kv.get("/x") == "v1"
+    assert kv.cad("/x", "v1")
+    assert kv.get("/x") is None
+
+
+def test_trainer_lease_incarnations_distinct(kv):
+    """Two incarnations of the same trainer id must hold DISTINGUISHABLE
+    leases: a stalled old incarnation's heartbeat cannot extend the
+    replacement's lease, so the old one observes `lost` (split-brain
+    guard — with a shared 'alive' value both would think they own the
+    slot)."""
+    old = TrainerLease(kv, "7", ttl=0.4)
+    time.sleep(0.1)
+    assert TrainerLease.live_trainers(kv) == ["7"]
+    # replacement incarnation overwrites the key (restart after a stall)
+    new = TrainerLease(kv, "7", ttl=0.4)
+    assert new._lease.value != old._lease.value
+    time.sleep(0.9)          # old heartbeats hit the expect-guard
+    assert old._lease.lost
+    assert not new._lease.lost
+    # old incarnation's graceful leave must not deregister the new one
+    old.leave()
+    assert TrainerLease.live_trainers(kv) == ["7"]
+    new.leave()
+    assert TrainerLease.live_trainers(kv) == []
