@@ -72,6 +72,42 @@ def main(pp=4, d=256, d_inner=1024, t=64, mb=2, layers_per_stage=2,
         meas = results[m] / results[m_max]
         theo = (m / (pp + m - 1)) / (m_max / (pp + m_max - 1))
         print("M=%2d  measured ratio %.2f   theory %.2f" % (m, meas, theo))
+
+    # interleaved virtual stages (the small-M 1F1B regime): V chunks per
+    # device cut the fill to (S-1)/V chunk-times — theory
+    # U_int(M) = M / (M + (S-1)/V) vs GPipe M / (M + S - 1)
+    v = layers_per_stage                   # one layer per chunk
+    L = pp * layers_per_stage
+
+    def interleave(p):
+        # stage-stacked [S, per, ...] -> global layer order [L, ...] ->
+        # device d holds chunks {d, d+S, ...}: [V, S, 1, ...] -> [S, V, 1,
+        # ...]
+        flat = p.reshape((L,) + p.shape[2:])
+        return flat.reshape((v, pp, 1) + p.shape[2:]).swapaxes(0, 1)
+
+    inter = {"w1": interleave(params["w1"]),
+             "w2": interleave(params["w2"])}
+    print("\nInterleaved (V=%d chunks/device) vs GPipe at small M:" % v)
+    for m in [mm for mm in ms if mm <= pp]:
+        xs = jnp.asarray(rng.randn(m, mb, t, d).astype(np.float32))
+
+        def run_i(xs=xs):
+            return parallel.gpipe_interleaved(
+                stage_fn, inter, xs, mesh, n_chunks=v, axis_name="pp")
+
+        jit_i = jax.jit(run_i)
+        jax.block_until_ready(jit_i())
+        n_rep = 3
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            jax.block_until_ready(jit_i())
+        dt = (time.perf_counter() - t0) / n_rep
+        toks = m * mb * t
+        speedup = (toks / dt) / results[m]
+        theo = (m + pp - 1) / (m + (pp - 1) / v)
+        print("M=%2d  %8.0f tok/s  %.2fx over GPipe  (theory %.2fx)"
+              % (m, toks / dt, speedup, theo))
     return results
 
 

@@ -82,23 +82,38 @@ def sparse_moe(x, num_experts, d_inner, capacity_factor=1.25,
 
 def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
                             num_microbatches=0, recompute=False,
-                            name=None):
+                            schedule="gpipe", virtual_stages=0,
+                            tp_shard=False, name=None):
     """L identical causal decoder layers with layer-stacked parameters
-    ([L, ...], leading dim sharded on the pp mesh axis → GPipe schedule
-    under ParallelExecutor; lax.scan over layers otherwise).
+    ([L, ...], leading dim sharded on the pp mesh axis → pipeline
+    schedule under ParallelExecutor; lax.scan over layers otherwise).
     recompute=True rematerializes each layer's activations in the
     backward pass (jax.checkpoint on the scan body).
+    schedule: "gpipe" (M >= S regime) or "interleaved" (Megatron
+    virtual stages — bubble cut by `virtual_stages` chunks per device;
+    requires M <= S). tp_shard=True adds Megatron col/row sharding
+    hints for a tp mesh axis (the pp x tp composition — the stage body
+    then psums per sublayer; ops/parallel_ops._decoder_layer_apply_tp).
     x: [B, T, D]. Returns [B, T, D]."""
     helper = LayerHelper("pipeline_stack", name=name)
     d = int(x.shape[-1])
     L = int(n_layer)
+    # storage-placement hints for the GLOBAL [L, ...] params (the op
+    # re-blocks them per schedule inside the jit); col/row tp tails
+    # mirror ops/parallel_ops._TP_SPEC_TAILS
+    tp_tails = {
+        ".wq": (None, "tp"), ".wk": (None, "tp"), ".wv": (None, "tp"),
+        ".wo": ("tp", None), ".w1": (None, "tp"), ".b1": ("tp",),
+        ".w2": ("tp", None),
+    }
 
     def p(suffix, shape, init):
         w = helper.create_parameter(ParamAttr(name=helper.name + suffix),
                                     shape=list(shape), dtype=x.dtype,
                                     default_initializer=init)
+        tail = tp_tails.get(suffix) if tp_shard else None
         helper.main_program._sharding_hints[w.name] = \
-            ("pp",) + (None,) * (len(shape) - 1)
+            ("pp",) + (tail or (None,) * (len(shape) - 1))
         return w
 
     std = d ** -0.5
@@ -122,5 +137,6 @@ def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
         inputs=dict({"X": [x]}, **{s: [w] for s, w in params.items()}),
         outputs={"Out": [out]},
         attrs={"n_head": n_head, "num_microbatches": num_microbatches,
-               "recompute": bool(recompute)})
+               "recompute": bool(recompute), "schedule": str(schedule),
+               "virtual_stages": int(virtual_stages)})
     return out

@@ -306,16 +306,23 @@ def transformer_lm_parallel(vocab_size=4096, max_len=256, n_layer=4,
     aux_losses = []
 
     if st.pp > 1:
-        if num_experts > 0 or st.tp > 1 or st.sp > 1:
-            # the GPipe stack runs whole layers inside shard_map with
-            # pp-only param specs; composing tp/sp/ep inside it needs
-            # nested manual collectives that are not implemented — refuse
-            # rather than silently train a different model
+        if num_experts > 0:
+            # MoE routing inside the pipeline stage would need the ep
+            # all-to-all nested in the pp shard_map with per-stage expert
+            # placement — refuse rather than silently train a different
+            # model; use ep without pp
             raise NotImplementedError(
-                "pp>1 composes with dp only (got tp=%d sp=%d experts=%d); "
-                "use tp/sp/ep without pp, or pp×dp"
-                % (st.tp, st.sp, num_experts))
-        x = layers.pipelined_decoder_stack(x, n_layer, n_head, d_inner)
+                "pp>1 does not compose with expert parallelism "
+                "(got experts=%d); use ep without pp" % num_experts)
+        # pp x tp: Megatron col/row shards inside the stage body with one
+        # psum per sublayer; pp x sp: ring attention over sp inside the
+        # stage (ops/parallel_ops._decoder_layer_apply_tp). dp shards
+        # microbatches throughout.
+        x = layers.pipelined_decoder_stack(
+            x, n_layer, n_head, d_inner,
+            schedule=getattr(st, "pp_schedule", "gpipe") or "gpipe",
+            virtual_stages=getattr(st, "pp_virtual_stages", 0),
+            tp_shard=st.tp > 1)
     else:
         for _ in range(n_layer):
             x = _parallel_decoder_layer(x, n_head, d_key, d_value, d_model,

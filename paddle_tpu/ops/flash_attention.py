@@ -32,8 +32,13 @@ from jax import lax
 
 _NEG_INF = -1e30
 _LANES = 128
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# None → auto block sizing in _resolve_path: large blocks win on the MXU
+# (measured: 256² runs the executed matmuls at half the rate of 1024² at
+# T=1024 — benchmarks/perf_probe_attn.py), while the causal block-skip
+# needs nq, nk >= 2 to pay off; both push toward min(T, 1024)
+DEFAULT_BLOCK_Q = None
+DEFAULT_BLOCK_K = None
+_AUTO_BLOCK = 1024
 
 
 def _dense(q, k, v, causal, scale):
@@ -70,28 +75,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    q = q_ref[0].astype(jnp.float32)            # [Bq, D]
-    kk = k_ref[0].astype(jnp.float32)           # [Bk, D]
-    s = jax.lax.dot_general(
-        q, kk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
-    if causal:
-        i = pl.program_id(1)
-        qi = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kj = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qi >= kj, s, _NEG_INF)
+    i = pl.program_id(1)   # hoisted: program_id inside a pl.when branch
+                           # does not interpret/lower on all paths
 
-    m_prev = m_s[:]                              # [Bq, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                       # [Bq, Bk]
-    l_new = alpha * l_s[:] + jnp.sum(p, axis=1, keepdims=True)
-    acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_s[:] = m_new
-    l_s[:] = l_new
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [Bq, D]
+        kk = k_ref[0].astype(jnp.float32)           # [Bk, D]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
+        if causal:
+            qi = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kj = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+
+        m_prev = m_s[:]                              # [Bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                       # [Bq, Bk]
+        l_new = alpha * l_s[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
+        l_s[:] = l_new
+
+    if causal:
+        # causal block skip: a block whose every key index exceeds every
+        # query index contributes exp(-inf)=0 — skip its matmuls (the
+        # MXU time, ~half the grid for T >> block). The m/l/acc scratch
+        # simply carries through.
+        pl.when(i * block_q + block_q - 1 >= j * block_k)(_compute)
+    else:
+        _compute()
 
     @pl.when(j == nk - 1)
     def _final():
@@ -151,24 +168,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    q = q_ref[0].astype(jnp.float32)
-    kk = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    i = pl.program_id(1)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kj = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])                   # [Bq, Bk]
+        dy = dy_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(dy, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale          # [Bq, Bk]
+        acc_s[:] = acc_s[:] + jax.lax.dot_general(
+            ds, kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     if causal:
-        i = pl.program_id(1)
-        qi = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kj = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qi >= kj, s, _NEG_INF)
-    p = jnp.exp(s - lse_ref[0][:, :1])                       # [Bq, Bk]
-    dy = dy_ref[0].astype(jnp.float32)
-    dp = jax.lax.dot_general(dy, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0][:, :1]) * scale              # [Bq, Bk]
-    acc_s[:] = acc_s[:] + jax.lax.dot_general(
-        ds, kk, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        pl.when(i * block_q + block_q - 1 >= j * block_k)(_compute)
+    else:
+        _compute()
 
     @pl.when(j == nk - 1)
     def _final():
@@ -185,27 +209,34 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, delta_ref,
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    q = q_ref[0].astype(jnp.float32)
-    kk = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    jj = pl.program_id(1)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kj = jj * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])                   # [Bq, Bk]
+        dy = dy_ref[0].astype(jnp.float32)
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p, dy, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [Bk, D]
+        dp = jax.lax.dot_general(dy, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [Bk, D]
+
     if causal:
-        jj = pl.program_id(1)
-        qi = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kj = jj * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qi >= kj, s, _NEG_INF)
-    p = jnp.exp(s - lse_ref[0][:, :1])                       # [Bq, Bk]
-    dy = dy_ref[0].astype(jnp.float32)
-    dv_s[:] = dv_s[:] + jax.lax.dot_general(
-        p, dy, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                  # [Bk, D]
-    dp = jax.lax.dot_general(dy, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0][:, :1]) * scale
-    dk_s[:] = dk_s[:] + jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                  # [Bk, D]
+        pl.when(i * block_q + block_q - 1 >= jj * block_k)(_compute)
+    else:
+        _compute()
 
     @pl.when(i == nq - 1)
     def _final():
@@ -220,6 +251,16 @@ def _bwd_pallas(res, dy, causal, scale, block_q, block_k, interpret,
     bh = b * h
     bq = min(block_q, t)
     bk = min(block_k, t)
+    # VMEM guard: the bwd kernels hold s/p/dp/ds [bq, bk] f32 plus six
+    # [block, d] operands; at 1024^2 blocks with d > 128 that exceeds the
+    # 16 MB scoped-vmem limit (measured: d=192 needs 21.3 MB). Clamp the
+    # BACKWARD blocks only — the fwd kernel carries one [bq, bk] buffer
+    # and fits. The clamp must keep dividing T (a non-divisor block
+    # would silently drop query rows from dq/dk/dv): shrink to the
+    # largest divisor of the incoming block, which also divides T.
+    if d > 128:
+        bq = _largest_divisor(bq, 512)
+        bk = _largest_divisor(bk, 512)
     nq, nk = t // bq, t // bk
     delta = jnp.sum(dy.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                  # [B,H,T]
@@ -333,12 +374,26 @@ def _on_tpu(x):
         return jax.default_backend() == "tpu"
 
 
+def _largest_divisor(n, limit):
+    """Largest d <= limit with n % d == 0 (block-size fitting; trace-time
+    only, n is a static shape)."""
+    d = min(limit, n)
+    while d > 1 and n % d:
+        d -= 1
+    return d
+
+
 def _resolve_path(q, scale, block_q, block_k, force):
     """Shared dispatch: (path, scale, bq, bk). path: "pallas" /
     "interpret" / "dense" — auto picks the kernel on TPU when T divides
-    the blocks and the head dim tiles onto the lanes."""
+    the blocks and the head dim tiles onto the lanes. block None → auto:
+    the largest divisor of T up to 1024 (the measured MXU sweet spot,
+    see DEFAULT_BLOCK_Q) — a divisor, so non-power-of-two T (1536, ...)
+    keeps the fused kernel instead of demoting to dense."""
     scale = float(scale) if scale else q.shape[-1] ** -0.5
     t = q.shape[2]
+    block_q = block_q or _largest_divisor(t, _AUTO_BLOCK)
+    block_k = block_k or _largest_divisor(t, _AUTO_BLOCK)
     path = force
     if path is None:
         usable = (t % min(block_q, t) == 0 and t % min(block_k, t) == 0

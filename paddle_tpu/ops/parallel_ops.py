@@ -96,22 +96,9 @@ def _moe_ffn(ctx, op):
 
 def _decoder_layer_apply(p, x, n_head):
     """One pre-LN-free (post-LN, matching models/transformer.py 'dan')
-    decoder-only layer from a param dict of arrays."""
-    b, t, d = x.shape
-    dk = d // n_head
-
-    def heads(z):
-        return z.reshape(b, t, n_head, dk).transpose(0, 2, 1, 3)
-
-    q = heads(x @ p["wq"])
-    k = heads(x @ p["wk"])
-    v = heads(x @ p["wv"])
-    a = _dense_attention(q, k, v, True, dk ** -0.5)
-    a = a.transpose(0, 2, 1, 3).reshape(b, t, d) @ p["wo"]
-    x = _ln_apply(x + a, p["ln1_s"], p["ln1_b"])
-    h = jax.nn.relu(x @ p["w1"] + p["b1"])
-    f = h @ p["w2"] + p["b2"]
-    return _ln_apply(x + f, p["ln2_s"], p["ln2_b"])
+    decoder-only layer from a param dict of arrays — the tp/sp twin with
+    both axes off (one copy of the math to keep in sync)."""
+    return _decoder_layer_apply_tp(p, x, n_head, None, None)
 
 
 def _ln_apply(x, scale, bias, eps=1e-5):
@@ -121,32 +108,94 @@ def _ln_apply(x, scale, bias, eps=1e-5):
     return ((xf - m) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
 
 
+def _decoder_layer_apply_tp(p, x, n_head, tp_axis, sp_axis=None):
+    """Megatron tensor-parallel twin of _decoder_layer_apply, for use
+    INSIDE shard_map (the pipeline stage body): p's matrix leaves are the
+    LOCAL tp shards — wq/wk/wv col-sharded [d, d/tp] (head-split), wo
+    row-sharded [d/tp, d], w1 col [d, f/tp] + b1 [f/tp], w2 row [f/tp, d]
+    — and each sublayer closes with ONE lax.psum over tp (the Megatron
+    g-operator). LN params and b2 are replicated; b2 adds after the psum.
+    With sp_axis set, activations arrive sequence-sharded [b, t/sp, d]
+    and attention runs the ring schedule over that axis (the pp x sp
+    composition)."""
+    b, t, d = x.shape
+    tp = lax.psum(1, tp_axis) if tp_axis else 1
+    h_local = n_head // tp
+    dk = d // n_head
+
+    def heads(z):
+        return z.reshape(b, t, h_local, dk).transpose(0, 2, 1, 3)
+
+    q = heads(x @ p["wq"])
+    k = heads(x @ p["wk"])
+    v = heads(x @ p["wv"])
+    if sp_axis:
+        from ..parallel.ring import _ring_attention_sharded
+        a = _ring_attention_sharded(q, k, v, sp_axis, True, dk ** -0.5)
+    else:
+        a = _dense_attention(q, k, v, True, dk ** -0.5)
+    part = a.transpose(0, 2, 1, 3).reshape(b, t, h_local * dk) @ p["wo"]
+    if tp_axis:
+        part = lax.psum(part, tp_axis)
+    x = _ln_apply(x + part, p["ln1_s"], p["ln1_b"])
+    h = jax.nn.relu(x @ p["w1"] + p["b1"])
+    f = h @ p["w2"]
+    if tp_axis:
+        f = lax.psum(f, tp_axis)
+    f = f + p["b2"]
+    return _ln_apply(x + f, p["ln2_s"], p["ln2_b"])
+
+
 _STACK_SLOTS = ("WQ", "WK", "WV", "WO", "LN1S", "LN1B", "W1", "B1", "W2",
                 "B2", "LN2S", "LN2B")
 _STACK_KEYS = ("wq", "wk", "wv", "wo", "ln1_s", "ln1_b", "w1", "b1", "w2",
                "b2", "ln2_s", "ln2_b")
 
 
+# per-leaf PartitionSpec tails (dims AFTER the leading stage/chunk dims)
+# for Megatron tp sharding of the stacked decoder params: in-projections
+# and w1 col-sharded, out-projections row-sharded, everything else
+# replicated (b2 adds after the psum)
+_TP_SPEC_TAILS = {
+    "wq": (None, None, "tp"), "wk": (None, None, "tp"),
+    "wv": (None, None, "tp"), "wo": (None, "tp", None),
+    "w1": (None, None, "tp"), "b1": (None, "tp"),
+    "w2": (None, "tp", None), "b2": (None, None),
+    "ln1_s": (None, None), "ln1_b": (None, None),
+    "ln2_s": (None, None), "ln2_b": (None, None),
+}
+
+
 @register("pipeline_stack")
 def _pipeline_stack(ctx, op):
     """A stack of L identical causal decoder layers with layer-STACKED
     parameters (leading dim L). With a pp mesh axis of size S the stack
-    runs as an S-stage GPipe (L/S layers per stage, activations on the ICI
-    ring); otherwise as a lax.scan over layers. Attrs: n_head,
-    num_microbatches (0 = auto 2*S), recompute (jax.checkpoint per
-    layer — scan-over-layers + remat is the standard memory-efficient
-    deep stack)."""
+    runs as an S-stage pipeline (L/S layers per stage, activations on the
+    ICI ring); otherwise as a lax.scan over layers. Attrs: n_head,
+    num_microbatches (0 = auto: 2*S for gpipe, S for interleaved),
+    recompute (jax.checkpoint per layer), schedule ("gpipe" |
+    "interleaved" — Megatron virtual stages, bubble/V, for the small-M
+    regime), virtual_stages (V chunks per device, interleaved only;
+    0 = auto L/S).
+
+    Composition: a tp mesh axis Megatron-shards every stage's weights
+    (col/row) with one psum per sublayer inside the stage body; an sp
+    axis shards the sequence dim and runs ring attention inside the
+    stage (parallel/ring._ring_attention_sharded). dp shards the
+    microbatch dim as before — dp x pp x tp x sp in one shard_map."""
     x = ctx.in1(op, "X")
     n_head = int(op.attr("n_head", 8))
-    layer_apply = functools.partial(_decoder_layer_apply, n_head=n_head)
-    if op.attr("recompute"):
-        layer_apply = jax.checkpoint(layer_apply)
     params = {key: ctx.in1(op, slot)
               for key, slot in zip(_STACK_KEYS, _STACK_SLOTS)}
     n_layer = params["wq"].shape[0]
     mesh = _mesh_axis(ctx, "pp")
 
     if mesh is None:
+        layer_apply = functools.partial(_decoder_layer_apply,
+                                        n_head=n_head)
+        if op.attr("recompute"):
+            layer_apply = jax.checkpoint(layer_apply)
+
         def body(carry, layer_p):
             return layer_apply(layer_p, carry), None
 
@@ -155,13 +204,24 @@ def _pipeline_stack(ctx, op):
         return
 
     from ..parallel import pipeline
-    s = mesh.shape["pp"]
-    if n_layer % s:
-        raise ValueError("pipeline_stack: %d layers not divisible by "
-                         "pp=%d stages" % (n_layer, s))
-    per = n_layer // s
-    stacked = {k: v.reshape((s, per) + v.shape[1:])
-               for k, v in params.items()}
+    tp_axis = "tp" if _mesh_axis(ctx, "tp") else None
+    sp_axis = "sp" if _mesh_axis(ctx, "sp") else None
+    if tp_axis:
+        tp = mesh.shape["tp"]
+        d_inner = params["w1"].shape[-1]
+        if n_head % tp or d_inner % tp:
+            raise ValueError(
+                "pipeline_stack tp composition needs n_head (%d) and "
+                "d_inner (%d) divisible by tp=%d" % (n_head, d_inner, tp))
+    if tp_axis or sp_axis:
+        layer_apply = functools.partial(_decoder_layer_apply_tp,
+                                        n_head=n_head, tp_axis=tp_axis,
+                                        sp_axis=sp_axis)
+    else:
+        layer_apply = functools.partial(_decoder_layer_apply,
+                                        n_head=n_head)
+    if op.attr("recompute"):
+        layer_apply = jax.checkpoint(layer_apply)
 
     def stage_fn(stage_params, mb):
         def body(carry, layer_p):
@@ -170,12 +230,50 @@ def _pipeline_stack(ctx, op):
         out, _ = lax.scan(body, mb, stage_params)
         return out
 
-    m = int(op.attr("num_microbatches", 0)) or 2 * s
+    s = mesh.shape["pp"]
+    schedule = str(op.attr("schedule", "") or "gpipe")
+    param_specs = {k: _TP_SPEC_TAILS[k] for k in params} if tp_axis \
+        else None
     b = x.shape[0]
-    if b % m:
-        raise ValueError("pipeline_stack: batch %d not divisible by %d "
-                         "microbatches" % (b, m))
-    mb = x.reshape((m, b // m) + x.shape[1:])
-    out = pipeline.gpipe(stage_fn, stacked, mb, mesh, axis_name="pp",
-                         batch_axis=_batch_axis(mesh))
+    if schedule == "interleaved":
+        v_chunks = int(op.attr("virtual_stages", 0)) or n_layer // s
+        if v_chunks < 1:
+            raise ValueError(
+                "pipeline_stack interleaved schedule needs at least one "
+                "chunk per device: %d layers < pp=%d stages"
+                % (n_layer, s))
+        if n_layer % (s * v_chunks):
+            raise ValueError(
+                "pipeline_stack: %d layers not divisible into %d stages "
+                "x %d virtual chunks" % (n_layer, s, v_chunks))
+        per = n_layer // (s * v_chunks)
+        # device d holds global chunks {d, d+S, ...}: [L,...] ->
+        # [V, S, per, ...] -> [S, V, per, ...]
+        stacked = {
+            k: p.reshape((v_chunks, s, per) + p.shape[1:]).swapaxes(0, 1)
+            for k, p in params.items()}
+        m = int(op.attr("num_microbatches", 0)) or min(s, b)
+        if b % m:
+            raise ValueError("pipeline_stack: batch %d not divisible by "
+                             "%d microbatches" % (b, m))
+        mb = x.reshape((m, b // m) + x.shape[1:])
+        out = pipeline.gpipe_interleaved(
+            stage_fn, stacked, mb, mesh, v_chunks, axis_name="pp",
+            batch_axis=_batch_axis(mesh), param_specs=param_specs,
+            seq_axis=sp_axis)
+    else:
+        if n_layer % s:
+            raise ValueError("pipeline_stack: %d layers not divisible by "
+                             "pp=%d stages" % (n_layer, s))
+        per = n_layer // s
+        stacked = {k: v.reshape((s, per) + v.shape[1:])
+                   for k, v in params.items()}
+        m = int(op.attr("num_microbatches", 0)) or 2 * s
+        if b % m:
+            raise ValueError("pipeline_stack: batch %d not divisible by "
+                             "%d microbatches" % (b, m))
+        mb = x.reshape((m, b // m) + x.shape[1:])
+        out = pipeline.gpipe(stage_fn, stacked, mb, mesh, axis_name="pp",
+                             batch_axis=_batch_axis(mesh),
+                             param_specs=param_specs, seq_axis=sp_axis)
     ctx.set_out(op, "Out", out.reshape(x.shape))

@@ -22,5 +22,5 @@ from .mesh import (  # noqa: F401
 from .executor import ParallelExecutor  # noqa: F401
 from . import collective  # noqa: F401
 from .ring import ring_attention, ulysses_attention  # noqa: F401
-from .pipeline import gpipe  # noqa: F401
+from .pipeline import gpipe, gpipe_interleaved  # noqa: F401
 from .moe import moe_ffn, top1_gating, topk_gating  # noqa: F401

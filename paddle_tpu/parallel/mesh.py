@@ -73,7 +73,8 @@ class DistributedStrategy:
     the TPU axes)."""
 
     def __init__(self, dp=None, tp=1, pp=1, sp=1, ep=1,
-                 use_bf16_compute=False, gradient_accumulation_steps=1):
+                 use_bf16_compute=False, gradient_accumulation_steps=1,
+                 pp_schedule="gpipe", pp_virtual_stages=0):
         self.dp = dp
         self.tp = tp
         self.pp = pp
@@ -81,6 +82,10 @@ class DistributedStrategy:
         self.ep = ep
         self.use_bf16_compute = use_bf16_compute
         self.gradient_accumulation_steps = gradient_accumulation_steps
+        # pipeline schedule: "gpipe" (M >= S) or "interleaved" (Megatron
+        # virtual stages, bubble / pp_virtual_stages; M <= S regime)
+        self.pp_schedule = pp_schedule
+        self.pp_virtual_stages = pp_virtual_stages
 
     def build_mesh(self, devices=None):
         devices = list(devices if devices is not None else jax.devices())

@@ -1,4 +1,4 @@
-"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe + interleaved).
 
 Beyond the 2018 reference (SURVEY.md §2.7: PP absent; the closest legacy
 analog is ParallelNeuralNetwork's static layer placement). TPU-native
@@ -7,6 +7,27 @@ design: stage parameters are STACKED on a leading [S, ...] axis sharded on
 shard, and activations ride the ICI ring via ``ppermute``. One jitted
 computation, S + M - 1 ticks for M microbatches (the classic GPipe bubble),
 differentiable end-to-end (grads flow through ppermute).
+
+Schedules:
+  * ``gpipe`` — all M microbatches stream through the S stages;
+    bubble fraction (S-1)/(S+M-1) per direction. Reverse-mode AD turns
+    the tick loop into the mirrored backward pipeline, so the memory
+    profile already matches 1F1B-with-flush (PipeDream-flush): both
+    schedules have the SAME bubble; 1F1B's classic win is activation
+    memory, which here is had with ``recompute`` on the stage body.
+  * ``gpipe_interleaved`` — Megatron-style virtual stages: each device
+    holds V non-contiguous layer CHUNKS (device d owns global chunks
+    {d, d+S, ...}), a microbatch makes V laps around the ring, and the
+    pipeline fill shrinks to (S-1) CHUNK times — bubble cut by V:
+    time = M·t_stage + (S-1)·t_stage/V  vs  (M+S-1)·t_stage.
+    This is the schedule that beats GPipe at small M (the interleaved
+    1F1B regime); it requires M <= S so at most one microbatch is in
+    flight per device per tick (the single-register SPMD carry).
+
+Composition with tensor parallelism: ``param_specs`` lets the stacked
+params carry extra mesh axes (e.g. Megatron col/row sharding on ``tp``);
+the stage_fn then runs INSIDE shard_map over both axes and issues its own
+``lax.psum`` over tp — see ops/parallel_ops._decoder_layer_apply_tp.
 
 Output handling: only the LAST stage produces real outputs, so the result
 leaves the shard_map with its leading axis sharded on ``pp`` and the
@@ -65,6 +86,45 @@ def _run_ticks(apply, xs, s_idx, n_stage, axis_name):
     return outputs[None]
 
 
+def _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
+                           n_chunks):
+    """Virtual-stage tick loop for one shard. apply: (chunk_idx, x) ->
+    chunk output for THIS device's local chunk `chunk_idx`. Microbatch i
+    is injected at tick i and makes V laps: at hop h (one hop per tick)
+    it sits on device h % S running global chunk h. With M <= S no two
+    microbatches ever share a device, so the carry stays one state
+    register. Total ticks: M - 1 + V*S."""
+    m = xs.shape[0]
+    total = n_chunks * n_stage
+
+    def tick(t, carry):
+        state_in, outputs = carry
+        # the unique hop index on THIS device at tick t: the largest
+        # h <= t with h ≡ s_idx (mod S); the microbatch holding it is
+        # mb = t - h (live iff mb < M and h < total)
+        h = t - ((t - s_idx) % n_stage)
+        mb = t - h
+        live = jnp.logical_and(h < total, mb < m)
+        inject = jnp.where(h == 0, xs[jnp.clip(mb, 0, m - 1)], state_in)
+        chunk = jnp.clip(h // n_stage, 0, n_chunks - 1)
+        out = apply(chunk, inject)
+        write = jnp.logical_and(live, h == total - 1)
+        mb_c = jnp.clip(mb, 0, m - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, out, outputs[mb_c]), mb_c, 0)
+        outputs = jnp.where(write, upd, outputs)
+        state_next = lax.ppermute(
+            out, axis_name,
+            [(j, (j + 1) % n_stage) for j in range(n_stage)])
+        return state_next, outputs
+
+    state0 = jnp.zeros_like(xs[0])
+    outputs0 = jnp.zeros_like(xs)
+    _, outputs = lax.fori_loop(0, m - 1 + total, tick,
+                               (state0, outputs0))
+    return outputs[None]
+
+
 def _gpipe_sharded(params, xs, stage_fn, axis_name):
     """Stacked (homogeneous) path: params leaves arrive [1, ...] — this
     shard's slice of the [S, ...] stack."""
@@ -73,6 +133,23 @@ def _gpipe_sharded(params, xs, stage_fn, axis_name):
     local_params = jax.tree_util.tree_map(lambda p: p[0], params)
     return _run_ticks(lambda x: stage_fn(local_params, x), xs, s_idx,
                       n_stage, axis_name)
+
+
+def _interleaved_sharded(params, xs, stage_fn, axis_name, n_chunks):
+    """Interleaved path: params leaves arrive [1, V, ...] — this shard's
+    V chunk slices. stage_fn(chunk_params, x) runs ONE chunk."""
+    s_idx = lax.axis_index(axis_name)
+    n_stage = lax.psum(1, axis_name)
+    local = jax.tree_util.tree_map(lambda p: p[0], params)
+
+    def apply(chunk, x):
+        cp = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, chunk, 0,
+                                               keepdims=False), local)
+        return stage_fn(cp, x)
+
+    return _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
+                                  n_chunks)
 
 
 def _gpipe_hetero(params_seq, xs, stage_fn, axis_name):
@@ -87,7 +164,7 @@ def _gpipe_hetero(params_seq, xs, stage_fn, axis_name):
 
 
 def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
-          batch_axis=None):
+          batch_axis=None, param_specs=None, seq_axis=None):
     """Run ``stage_fn(params_i, x)`` as an S-stage pipeline.
 
     stacked_params: EITHER a pytree whose leaves have leading dim S
@@ -95,14 +172,23 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
                     scalable form — OR a list/tuple of S per-stage
                     pytrees with arbitrary per-stage shapes (replicated
                     to every device, selected by stage index).
-    microbatches:   [M, mb, ...] array of M microbatches.
+    microbatches:   [M, mb, T, ...] array of M microbatches.
     batch_axis:     mesh axis the mb dim is data-sharded on (e.g. "dp"),
                     None if replicated.
+    param_specs:    optional pytree of PartitionSpecs for the NON-leading
+                    dims of the stacked params (tensor-parallel
+                    composition: Megatron col/row shards on "tp"; the
+                    leading ``axis_name`` entry is prepended here). The
+                    stage_fn then runs inside shard_map over both axes
+                    and must psum its partial sums over the tp axis.
+    seq_axis:       mesh axis the T (dim-2) activation dim is sharded on
+                    (sequence-parallel composition: the stage_fn must
+                    run ring/Ulysses attention over that axis).
     Returns [M, mb, ...] outputs of the final stage.
     """
     s = mesh.shape[axis_name]
-    xspec = P(None, batch_axis)
-    out_spec = P(axis_name, None, batch_axis)
+    xspec = P(None, batch_axis, seq_axis)
+    out_spec = P(axis_name, None, batch_axis, seq_axis)
 
     if isinstance(stacked_params, (list, tuple)):
         if len(stacked_params) != s:
@@ -123,10 +209,60 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
             raise ValueError(
                 "stacked_params leading dim %d != %d pipeline stages"
                 % (leaf.shape[0], s))
-    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        pspec = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                       stacked_params)
+    else:
+        pspec = jax.tree_util.tree_map(
+            lambda sp: P(axis_name, *sp), param_specs,
+            is_leaf=lambda x: isinstance(x, (P, tuple)))
     fn = shard_map(
         functools.partial(_gpipe_sharded, stage_fn=stage_fn,
                           axis_name=axis_name),
+        mesh=mesh, in_specs=(pspec, xspec), out_specs=out_spec,
+        check_vma=False)
+    return fn(stacked_params, microbatches)[-1]
+
+
+def gpipe_interleaved(stage_fn, stacked_params, microbatches, mesh,
+                      n_chunks, axis_name="pp", batch_axis=None,
+                      param_specs=None, seq_axis=None):
+    """Interleaved virtual-stage pipeline (Megatron 1F1B-interleaved
+    regime): device d holds the V = n_chunks chunk param slices
+    {d, d+S, ...}; bubble = (S-1)/V chunk-times instead of (S-1)
+    stage-times — the schedule that beats GPipe at small M.
+
+    stacked_params: pytree with leaves [S, V, per_chunk, ...] — leading
+                    dim sharded on ``axis_name``, dim 1 the local chunk
+                    index (see ops/parallel_ops for the [L,...] →
+                    [S, V, ...] interleave reshape).
+    microbatches:   [M, mb, ...], M <= S (single in-flight microbatch
+                    per device per tick).
+    stage_fn(chunk_params, x) runs ONE chunk (per_chunk layers).
+    """
+    s = mesh.shape[axis_name]
+    m = microbatches.shape[0]
+    if m > s:
+        raise ValueError(
+            "interleaved schedule needs microbatches M=%d <= S=%d "
+            "pipeline stages (use gpipe for the large-M regime)" % (m, s))
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != s or leaf.shape[1] != n_chunks:
+            raise ValueError(
+                "interleaved stacked_params leaves must be "
+                "[S=%d, V=%d, ...]; got %s" % (s, n_chunks, leaf.shape))
+    xspec = P(None, batch_axis, seq_axis)
+    out_spec = P(axis_name, None, batch_axis, seq_axis)
+    if param_specs is None:
+        pspec = jax.tree_util.tree_map(lambda _: P(axis_name, None),
+                                       stacked_params)
+    else:
+        pspec = jax.tree_util.tree_map(
+            lambda sp: P(axis_name, None, *sp), param_specs,
+            is_leaf=lambda x: isinstance(x, (P, tuple)))
+    fn = shard_map(
+        functools.partial(_interleaved_sharded, stage_fn=stage_fn,
+                          axis_name=axis_name, n_chunks=n_chunks),
         mesh=mesh, in_specs=(pspec, xspec), out_specs=out_spec,
         check_vma=False)
     return fn(stacked_params, microbatches)[-1]

@@ -172,3 +172,39 @@ def test_packed_encdec_transformer_matches_masked():
     assert "sp_attention" in [op.type for op in p2.global_block().ops]
     assert "sp_attention" not in [op.type
                                   for op in p1.global_block().ops]
+
+
+def test_auto_blocks_divide_non_pow2_t():
+    """Auto block sizing must pick a DIVISOR of T (largest <= 1024), so
+    T=1536 keeps the fused kernel instead of demoting to dense."""
+    path, _, bq, bk = FA._resolve_path(
+        jnp.zeros((1, 1, 1536, 128)), None, None, None, "interpret")
+    assert bq == 768 and bk == 768
+    assert 1536 % bq == 0
+    # and the kernel at those blocks matches dense
+    q, k, v = _qkv(b=1, h=1, t=1536, d=32, seed=3)
+    got = FA.flash_attention(q, k, v, causal=True, force="interpret")
+    ref = FA._dense(q, k, v, True, 32 ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-3, rtol=2e-2)
+
+
+def test_bwd_vmem_clamp_keeps_divisibility():
+    """The d>128 backward block clamp must shrink to a DIVISOR of T: at
+    T=768, d=192 the clamp (512 -> 384) still covers every query row —
+    gradients match dense (a non-divisor 512 would silently drop rows
+    512-767 from dq/dk/dv)."""
+    q, k, v = _qkv(b=1, h=1, t=768, d=192, seed=4)
+
+    def grads(att):
+        def f(q, k, v):
+            return (att(q, k, v) ** 2).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_ref = grads(lambda q, k, v: FA._dense(q, k, v, True, 192 ** -0.5))
+    g_fa = grads(lambda q, k, v: FA.flash_attention(
+        q, k, v, causal=True, force="interpret"))
+    for name, a, b in zip("qkv", g_ref, g_fa):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-9
+        err = float(jnp.max(jnp.abs(a - b))) / scale
+        assert err < 5e-3, (name, err)

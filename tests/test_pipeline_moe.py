@@ -228,3 +228,119 @@ def test_gpipe_heterogeneous_stage_params():
     g = jax.grad(loss)(params)
     assert np.abs(np.asarray(g[0]["w"])).max() > 0
     assert np.abs(np.asarray(g[1]["s"])).max() > 0
+
+
+def test_gpipe_interleaved_matches_sequential():
+    """Interleaved virtual stages (V chunks per device, Megatron
+    assignment {d, d+S, ...}): same math as the sequential stack, with
+    the bubble cut to (S-1)/V chunk-times (pipeline.gpipe_interleaved)."""
+    mesh = parallel.make_mesh({"pp": 4})
+    rng = np.random.RandomState(0)
+    s, v, d = 4, 2, 8
+    L = s * v                              # one layer per chunk
+    ws = rng.randn(L, d, d).astype(np.float32) * 0.3
+    bs = rng.randn(L, d).astype(np.float32) * 0.1
+    # device dd holds global chunks {dd, dd+S}: [L,...] -> [V,S,...] ->
+    # [S,V,...] (the op lowering's interleave reshape, per=1 folded in)
+    params = {
+        "w": jnp.asarray(ws).reshape(v, s, d, d).swapaxes(0, 1),
+        "b": jnp.asarray(bs).reshape(v, s, d).swapaxes(0, 1)}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    m, mb = 4, 2                           # M <= S regime
+    xs = rng.randn(m, mb, d).astype(np.float32)
+    got = np.asarray(parallel.gpipe_interleaved(
+        stage_fn, params, jnp.asarray(xs), mesh, n_chunks=v,
+        axis_name="pp"))
+    want = xs.copy()
+    for i in range(L):
+        want = np.tanh(want @ ws[i] + bs[i])
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    # differentiable, every chunk's params receive gradient
+    def loss(ps):
+        return jnp.sum(parallel.gpipe_interleaved(
+            stage_fn, ps, jnp.asarray(xs), mesh, n_chunks=v,
+            axis_name="pp") ** 2)
+
+    g = np.asarray(jax.grad(loss)(params)["w"])
+    assert np.isfinite(g).all()
+    assert (np.abs(g).reshape(s * v, -1).max(axis=1) > 0).all()
+
+    # M > S is a different schedule regime: refused loudly
+    with pytest.raises(ValueError, match="interleaved"):
+        parallel.gpipe_interleaved(
+            stage_fn, params, jnp.asarray(rng.randn(6, 2, d)), mesh,
+            n_chunks=v, axis_name="pp")
+
+
+def _lm_parallel_loss(strategy, mesh_axes, prefix, num_experts=0):
+    """Build transformer_lm_parallel under `strategy`, run ONE step on
+    the given mesh, return (loss, updated first pipeline weight)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.models import transformer as T
+
+    mesh = parallel.make_mesh(mesh_axes) if mesh_axes else None
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard(prefix):
+        avg, _ = T.transformer_lm_parallel(
+            vocab_size=64, max_len=16, n_layer=4, n_head=4, d_model=32,
+            d_inner=64, strategy=strategy, num_experts=num_experts)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(5)
+        feeds = T.make_lm_batch(rng, 8, 16, 64)
+        if mesh is None:
+            l, = exe.run(feed=feeds, fetch_list=[avg])
+        else:
+            pexe = fluid.ParallelExecutor(loss_name=avg.name,
+                                          main_program=main, mesh=mesh,
+                                          scope=scope)
+            l, = pexe.run([avg], feed=feeds)
+        wname = prefix + "pipeline_stack_0.wq"
+        w = scope.find_var(wname)
+        return float(np.asarray(l)), (np.asarray(w) if w is not None
+                                      else None)
+
+
+def test_pipeline_composes_with_tp_and_sp():
+    """pp x tp (Megatron shards + psum inside the stage) and pp x sp
+    (ring attention inside the stage) match the pp-only run, which
+    matches dense single-device math (lifting the round-3 refusal at
+    models/transformer.py)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    st_pp = parallel.DistributedStrategy(dp=1, pp=2)
+    l_pp, w_pp = _lm_parallel_loss(st_pp, {"dp": 1, "pp": 2}, "pa_")
+    st_tp = parallel.DistributedStrategy(dp=1, pp=2, tp=2)
+    l_tp, w_tp = _lm_parallel_loss(st_tp, {"dp": 1, "pp": 2, "tp": 2},
+                                   "pb_")
+    st_sp = parallel.DistributedStrategy(dp=1, pp=2, sp=2)
+    l_sp, w_sp = _lm_parallel_loss(st_sp, {"dp": 1, "pp": 2, "sp": 2},
+                                   "pc_")
+    np.testing.assert_allclose(l_tp, l_pp, rtol=2e-4)
+    np.testing.assert_allclose(l_sp, l_pp, rtol=2e-4)
+    # updated WEIGHTS match too, not just the loss
+    np.testing.assert_allclose(w_tp, w_pp, rtol=2e-3, atol=2e-5)
+    np.testing.assert_allclose(w_sp, w_pp, rtol=2e-3, atol=2e-5)
+
+
+def test_pipeline_interleaved_schedule_parity():
+    """The interleaved schedule through the layer DSL trains the same
+    model as gpipe (same loss + updated weights)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    st_g = parallel.DistributedStrategy(dp=2, pp=2)
+    l_g, w_g = _lm_parallel_loss(st_g, {"dp": 2, "pp": 2}, "qa_")
+    st_i = parallel.DistributedStrategy(dp=2, pp=2,
+                                        pp_schedule="interleaved")
+    l_i, w_i = _lm_parallel_loss(st_i, {"dp": 2, "pp": 2}, "qb_")
+    np.testing.assert_allclose(l_i, l_g, rtol=2e-4)
+    np.testing.assert_allclose(w_i, w_g, rtol=2e-3, atol=2e-5)
