@@ -56,45 +56,53 @@ def main():
     scope_mod._global_scope = scope_mod.Scope()
     fluid.amp.enable_amp(False)
 
-    # bs256: the throughput-saturating batch for the 4L/d512 config —
-    # bs32 is dispatch-latency-bound at less than half this rate
-    # (PERF.md batch sweep)
-    _run(["--batch_size", "256", "--iterations", "10",
-          "--skip_batch_num", "3", "--device", "TPU",
-          "--dtype", "bfloat16"])
-    try:
-        from transformer import main as transformer_main
-        tps = float(transformer_main())
-    except Exception as e:                      # ResNet stays the headline
-        print("transformer bench failed: %s" % e, file=sys.stderr)
-        tps = None
-
-    # the LARGE transformer config (8L d1024 ffn4096 T1024): matmul-bound,
-    # the MFU-representative capability number (PERF.md: MFU rises with
-    # d_model; the 4L/d512 line above is the least favorable config)
     def _fresh():
         fluid.switch_main_program(fluid.Program())
         fluid.switch_startup_program(fluid.Program())
         scope_mod._global_scope = scope_mod.Scope()
         fluid.amp.enable_amp(False)
 
-    _fresh()
-    L, D, FFN, T, V = 8, 1024, 4096, 1024, 8192
-    _run(["--batch_size", "8", "--iterations", "10",
-          "--skip_batch_num", "3", "--device", "TPU",
-          "--dtype", "bfloat16", "--n_layer", str(L), "--d_model", str(D),
-          "--d_inner", str(FFN), "--max_len", str(T)])
-    try:
-        from transformer import main as transformer_main2
-        tps_large = float(transformer_main2())
-        flops_tok_large = 3 * (L * (8 * D * D + 4 * D * FFN + 4 * T * D)
-                               + 2 * D * V)
-        mfu_large = tps_large * flops_tok_large / PEAK_BF16
-        print("Transformer-large MFU %.1f%% (%.0f tok/s)"
-              % (mfu_large * 100, tps_large), file=sys.stderr)
-    except Exception as e:
-        print("transformer-large bench failed: %s" % e, file=sys.stderr)
-        tps_large = mfu_large = None
+    import importlib
+
+    def transformer_bench(label, bs, L=4, D=512, FFN=2048, T=256,
+                          V=8192, heads=None):
+        """One transformer config through benchmarks/transformer.py;
+        returns (tok/s, mfu) or (None, None) — ResNet stays the
+        headline even if a transformer config fails."""
+        _fresh()
+        argv = ["--batch_size", str(bs), "--iterations", "10",
+                "--skip_batch_num", "3", "--device", "TPU",
+                "--dtype", "bfloat16", "--n_layer", str(L),
+                "--d_model", str(D), "--d_inner", str(FFN),
+                "--max_len", str(T), "--vocab", str(V)]
+        if heads:
+            argv += ["--n_head", str(heads)]
+        _run(argv)
+        try:
+            import transformer as tmod
+            tps = float(importlib.reload(tmod).main())
+        except Exception as e:
+            print("%s bench failed: %s" % (label, e), file=sys.stderr)
+            return None, None
+        flops_tok = 3 * (L * (8 * D * D + 4 * D * FFN + 4 * T * D)
+                         + 2 * D * V)
+        mfu = tps * flops_tok / PEAK_BF16
+        print("%s MFU %.1f%% (%.0f tok/s)" % (label, mfu * 100, tps),
+              file=sys.stderr)
+        return tps, mfu
+
+    # bs256: the throughput-saturating batch for the 4L/d512 config —
+    # bs32 is dispatch-latency-bound at less than half this rate
+    # (PERF.md batch sweep)
+    tps, _ = transformer_bench("Transformer-small", bs=256)
+    # the LARGE config (8L d1024 ffn4096 T1024): kept unchanged for
+    # round-over-round comparability
+    tps_large, mfu_large = transformer_bench(
+        "Transformer-large", bs=8, L=8, D=1024, FFN=4096, T=1024)
+    # the XL config — the best honest MFU this chip reaches (width
+    # sweep, PERF.md round 4): 8L d2048 ffn8192 T1024, head dim 128
+    tps_xl, mfu_xl = transformer_bench(
+        "Transformer-XL", bs=8, L=8, D=2048, FFN=8192, T=1024, heads=16)
 
     out = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
@@ -108,6 +116,9 @@ def main():
     if tps_large is not None:
         out["transformer_large_tokens_per_sec_per_chip"] = round(tps_large, 0)
         out["transformer_large_mfu_pct"] = round(mfu_large * 100, 1)
+    if tps_xl is not None:
+        out["transformer_xl_tokens_per_sec_per_chip"] = round(tps_xl, 0)
+        out["transformer_xl_mfu_pct"] = round(mfu_xl * 100, 1)
     print(json.dumps(out))
 
 

@@ -1,0 +1,107 @@
+"""Bottleneck-block BN probe: does the ResNet BN tax reproduce in pure
+jax once the real block structure (1x1 -> 3x3 -> 1x1 + shortcut add,
+stride-2 stage entry) is present?  Compares train-BN / test-BN / no-BN
+for a stack of stage-2 bottleneck blocks at bs256 — pure jax, no
+framework. If the tax shows here, it is XLA-structural; if not, the
+framework lowering is the suspect."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def time_fn(name, fn, *args, iters=10, windows=5):
+    f = jax.jit(fn)
+    r = f(*args)
+    float(r)
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = f(*args)
+        float(r)
+        times.append((time.perf_counter() - t0) / iters)
+    times.sort()
+    med = times[len(times) // 2]
+    print("%-28s %8.3f ms" % (name, med * 1000), flush=True)
+    return med
+
+
+def conv(x, w, stride=1, pad=0):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad)] * 2,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def bn(y, gamma, mode):
+    if mode == "none":
+        return y, 0.0
+    yf = y.astype(jnp.float32)
+    if mode == "train":
+        m = jnp.mean(yf, axis=(0, 2, 3))
+        v = jnp.maximum(jnp.mean(yf * yf, axis=(0, 2, 3)) - m * m, 0.0)
+    else:
+        m = jnp.zeros(y.shape[1], jnp.float32)
+        v = jnp.ones(y.shape[1], jnp.float32)
+    inv = jax.lax.rsqrt(v + 1e-5)
+    a = (gamma * inv).astype(y.dtype).reshape(1, -1, 1, 1)
+    b = (-m * gamma * inv).astype(y.dtype).reshape(1, -1, 1, 1)
+    return y * a + b, jnp.sum(m)
+
+
+def block(x, p, mode, stride=1):
+    sc = x if stride == 1 and x.shape[1] == p["w3"].shape[0] else \
+        bn(conv(x, p["ws"], stride), p["gs"], mode)[0]
+    y1, t1 = bn(conv(x, p["w1"], stride), p["g1"], mode)
+    y1 = jax.nn.relu(y1)
+    y2, t2 = bn(conv(y1, p["w2"], 1, pad=1), p["g2"], mode)
+    y2 = jax.nn.relu(y2)
+    y3, t3 = bn(conv(y2, p["w3"], 1), p["g3"], mode)
+    return jax.nn.relu(y3 + sc), t1 + t2 + t3
+
+
+def main():
+    n = 256
+    cin, cmid, cout, hw = 256, 128, 512, 28
+    depth = 4
+    rng = np.random.RandomState(0)
+
+    def mk(*shape):
+        return jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.05
+
+    params = []
+    for i in range(depth):
+        ci = cin if i == 0 else cout
+        params.append({
+            "w1": mk(cmid, ci, 1, 1), "g1": jnp.ones(cmid, jnp.float32),
+            "w2": mk(cmid, cmid, 3, 3), "g2": jnp.ones(cmid, jnp.float32),
+            "w3": mk(cout, cmid, 1, 1), "g3": jnp.ones(cout, jnp.float32),
+            "ws": mk(cout, ci, 1, 1), "gs": jnp.ones(cout, jnp.float32),
+        })
+    x = jnp.asarray(rng.randn(n, cin, hw * 2, hw * 2), jnp.bfloat16) * 0.3
+
+    for mode in ("train", "test", "none"):
+        def body(x, params, mode=mode):
+            tot = 0.0
+            cur = x
+            for i, p in enumerate(params):
+                cur, t = block(cur, p, mode, stride=2 if i == 0 else 1)
+                tot = tot + t
+            return jnp.sum(cur.astype(jnp.float32)) + tot
+
+        def run(x, params, body=body):
+            l, g = jax.value_and_grad(body, argnums=1)(x, params)
+            return l
+
+        time_fn("blocks %s" % mode, run, x, params)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Model-realistic conv->BN(train)->relu chain probe (round-4 #1).
+
+The round-3 model-level ablation measured BN train-stats at ~16 ms/step,
+but a bare conv+reduce microbench shows no such tax — so WHERE does it
+go? This probe times a realistic 8-deep chain conv -> stats -> normalize
+-> relu -> conv ... fwd+bwd, in four variants, NCHW vs NHWC:
+  a) train-mode BN (batch stats)           — the full cost
+  b) inference-mode BN (running stats)     — no stat reductions
+  c) no BN at all (conv -> relu)           — the floor
+The (a)-(b) delta is the stats tax in situ; NHWC vs NCHW shows whether
+the tax is layout-induced (TPU convs are NHWC-native).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def time_fn(name, fn, *args, iters=10, windows=5):
+    f = jax.jit(fn)
+    r = f(*args)
+    float(r)
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = f(*args)
+        float(r)
+        times.append((time.perf_counter() - t0) / iters)
+    times.sort()
+    med = times[len(times) // 2]
+    print("%-40s %8.3f ms" % (name, med * 1000), flush=True)
+    return med
+
+
+def make_chain(layout, mode, n, h, w, c, k=3, depth=8):
+    dn = ("NCHW", "OIHW", "NCHW") if layout == "NCHW" else \
+        ("NHWC", "HWIO", "NHWC")
+    ch_axis = 1 if layout == "NCHW" else 3
+    red = tuple(i for i in range(4) if i != ch_axis)
+    bshape = [1, 1, 1, 1]
+    bshape[ch_axis] = c
+    nelem = n * h * w
+
+    def body(x, ws, gammas):
+        tot = 0.0
+        exports = []                 # per-layer [C] state outputs
+        sg = jax.lax.stop_gradient
+        for i in range(depth):
+            y = jax.lax.conv_general_dilated(
+                x, ws[i], (1, 1), [(k // 2, k // 2)] * 2,
+                dimension_numbers=dn)
+            if mode in ("train", "train_export", "train_sg"):
+                yf = y.astype(jnp.float32)
+                s1 = jnp.sum(yf, axis=red) / nelem
+                s2 = jnp.sum(yf * yf, axis=red) / nelem
+                var = jnp.maximum(s2 - s1 * s1, 0.0)
+                if mode == "train_sg":
+                    # framework-like: the running-stat update chain is
+                    # stop_gradient'ed state
+                    exports.append(sg(0.9 * gammas[i] + 0.1 * s1))
+                    exports.append(sg(0.9 * gammas[i] + 0.1 * var))
+                elif mode == "train_export":
+                    exports.append(s1)
+                    exports.append(var)
+                inv = jax.lax.rsqrt(var + 1e-5)
+                a = (gammas[i] * inv).astype(y.dtype)
+                b = (-s1 * gammas[i] * inv).astype(y.dtype)
+                x = jax.nn.relu(y * a.reshape(bshape)
+                                + b.reshape(bshape))
+                tot = tot + jnp.sum(s1)
+            elif mode == "test":
+                a = gammas[i].astype(y.dtype)
+                x = jax.nn.relu(y * a.reshape(bshape))
+            else:
+                x = jax.nn.relu(y)
+        return jnp.sum(x.astype(jnp.float32)) + tot, exports
+
+    return body
+
+
+def main():
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=64)
+    args = p.parse_args()
+    n, h, w, c = args.n, 56, 56, 64
+    depth = 8
+    rng = np.random.RandomState(0)
+    for layout in ("NCHW", "NHWC"):
+        if layout == "NCHW":
+            x = jnp.asarray(rng.randn(n, c, h, w), jnp.bfloat16) * 0.3
+            ws = jnp.asarray(rng.randn(depth, c, c, 3, 3),
+                             jnp.bfloat16) * 0.05
+        else:
+            x = jnp.asarray(rng.randn(n, h, w, c), jnp.bfloat16) * 0.3
+            ws = jnp.asarray(rng.randn(depth, 3, 3, c, c),
+                             jnp.bfloat16) * 0.05
+        gammas = jnp.ones((depth, c), jnp.float32)
+        for mode in ("train", "train_export", "train_sg", "test",
+                     "none"):
+            body = make_chain(layout, mode, n, h, w, c, depth=depth)
+
+            def run(x, ws, gammas, body=body):
+                (l, ex), g = jax.value_and_grad(
+                    body, has_aux=True)(x, ws, gammas)
+                for e in ex:
+                    l = l + jnp.sum(e)         # keep exports live
+                return l
+
+            time_fn("%s %s bs%d" % (layout, mode, n), run, x, ws, gammas)
+
+
+if __name__ == "__main__":
+    main()

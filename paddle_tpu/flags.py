@@ -70,6 +70,12 @@ _register("profile_memory", bool, False,
 _register("data_home", str,
           os.path.expanduser("~/.cache/paddle_tpu/dataset"),
           "dataset cache directory")
+_register("fuse_conv_bn", bool, False,
+          "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
+          "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "
+          "than XLA's composed path on ResNet-50 (PERF.md round-4 "
+          "'conv+BN fusion probe'); kept as the committed evidence and "
+          "an opt-in for other shapes")
 
 
 def get_flag(name):

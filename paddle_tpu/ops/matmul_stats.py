@@ -1,0 +1,175 @@
+"""Fused matmul + per-column batch statistics — the conv+BN bandwidth
+kernel (round-4 directive #1).
+
+ResNet's measured BN tax (PERF.md "ResNet-50 delta breakdown") is ~16 ms
+of batch-stat passes: every conv output is re-read once forward (and its
+gradient re-reduced backward) just to compute per-channel sum / sum-of-
+squares. A 1x1 convolution is a matmul over [N*H*W, Cin]; this kernel
+streams the matmul result out of VMEM while accumulating the SHIFTED
+column stats s1 = sum(y - c), s2 = sum((y - c)^2) in a scratch register —
+the stats pass disappears into the conv epilogue. The shift c (the BN
+running mean, stop-gradient) keeps the one-pass variance form
+numerically stable exactly like ops/nn.py's composed path:
+var = s2/n - (s1/n)^2 with c near the true mean.
+
+Backward (custom_vjp): the stats cotangents fold into the matmul
+cotangent elementwise — dYtot = dY + ds1 + 2 (Y - c) ds2 — and the two
+transposed matmuls run through XLA (they are MXU-bound; only the
+forward's fused stat epilogue needs Pallas).
+
+Reference capability: fused conv+BN is the training-time analog of the
+reference's inference-only conv-BN folding
+(python/paddle/fluid/inference_transpiler.py:21); the reference never
+fused the training pass.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_LANES = 128
+
+
+def _dense_matmul_stats(x, w, c):
+    y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    yc = y - c[None, :].astype(jnp.float32)
+    s1 = jnp.sum(yc, axis=0)
+    s2 = jnp.sum(yc * yc, axis=0)
+    return y.astype(x.dtype), s1, s2
+
+
+def _kernel(x_ref, w_ref, c_ref, y_ref, s1_ref, s2_ref, s1_s, s2_s,
+            *, nm):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        s1_s[:] = jnp.zeros_like(s1_s)
+        s2_s[:] = jnp.zeros_like(s2_s)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    yc = y - c_ref[...].astype(jnp.float32)
+    s1_s[:] = s1_s[:] + jnp.sum(yc, axis=0, keepdims=True)
+    s2_s[:] = s2_s[:] + jnp.sum(yc * yc, axis=0, keepdims=True)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(i == nm - 1)
+    def _final():
+        s1_ref[...] = s1_s[:]
+        s2_ref[...] = s2_s[:]
+
+
+def _largest_divisor(n, limit):
+    d = min(limit, n)
+    while d > 1 and n % d:
+        d -= 1
+    return d
+
+
+def _fwd_pallas(x, w, c, interpret):
+    m, k = x.shape
+    n = w.shape[1]
+    bm = _largest_divisor(m, 1024)
+    # VMEM fit: resident W (k*n) + double-buffered x (bm*k) and y (bm*n)
+    # blocks + the f32 matmul temp (bm*n*4). Shrink bm until the
+    # estimate fits the ~16 MB scoped budget with headroom.
+    isz = x.dtype.itemsize
+
+    def footprint(b):
+        return (k * n * isz + 2 * b * k * isz + 2 * b * n * isz
+                + b * n * 4)
+
+    while bm > 128 and footprint(bm) > 10 * 1024 * 1024:
+        bm = _largest_divisor(m, bm // 2)
+    nm = m // bm
+    y, s1, s2 = pl.pallas_call(
+        functools.partial(_kernel, nm=nm),
+        grid=(nm,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, n), jnp.float32),
+                        pltpu.VMEM((1, n), jnp.float32)],
+        interpret=interpret,
+    )(x, w, c.reshape(1, n))
+    return y, s1[0], s2[0]
+
+
+def _on_tpu(x):
+    try:
+        return list(x.devices())[0].platform == "tpu"
+    except Exception:
+        return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _mmstats(x, w, c, path):
+    return _mmstats_fwd(x, w, c, path)[0]
+
+
+def _mmstats_fwd(x, w, c, path):
+    if path == "dense":
+        out = _dense_matmul_stats(x, w, c)
+    else:
+        out = _fwd_pallas(x, w, c, path == "interpret")
+    y = out[0]
+    return out, (x, w, c, y)
+
+
+def _mmstats_bwd(path, res, dout):
+    x, w, c, y = res
+    dy, ds1, ds2 = dout
+    yc = y.astype(jnp.float32) - c[None, :].astype(jnp.float32)
+    dytot = (dy.astype(jnp.float32) + ds1[None, :]
+             + 2.0 * yc * ds2[None, :]).astype(x.dtype)
+    dx = jax.lax.dot_general(dytot, w, (((1,), (1,)), ((), ())))
+    dw = jax.lax.dot_general(x, dytot, (((0,), (0,)), ((), ())))
+    return dx, dw, None
+
+
+_mmstats.defvjp(_mmstats_fwd, _mmstats_bwd)
+
+
+def matmul_colstats(x, w, c, force=None):
+    """y = x @ w with fused shifted column stats.
+
+    x [M, K], w [K, N], c [N] (per-column shift, treated as constant —
+    pass a stop_gradient of the BN running mean). Returns
+    (y [M, N] in x.dtype, s1 [N] f32, s2 [N] f32) with
+    s1 = sum_rows(y - c), s2 = sum_rows((y - c)^2) accumulated in f32.
+    force: None = auto (Pallas on TPU when shapes tile), "pallas" /
+    "interpret" / "dense".
+    """
+    m, k = x.shape
+    n = w.shape[1]
+    path = force
+    if path is None:
+        # whole-W-resident kernel: W + one X/Y block must fit VMEM
+        usable = (k * n * x.dtype.itemsize <= 4 * 1024 * 1024
+                  and n % _LANES == 0 and k % 8 == 0
+                  and m >= 512)
+        path = "pallas" if (usable and _on_tpu(x)) else "dense"
+    return _mmstats(x, w, c, path)
+
+
+# pallas imports at the end so CPU-only environments that never take the
+# kernel path still import this module
+from jax.experimental import pallas as pl                    # noqa: E402
+from jax.experimental.pallas import tpu as pltpu             # noqa: E402

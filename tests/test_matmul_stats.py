@@ -1,0 +1,121 @@
+"""Fused matmul + column-stats kernel (ops/matmul_stats.py) and the
+conv+BN stat-fusion path it powers (conv.py _maybe_conv1x1_bn_fused)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.ops import matmul_stats as MS
+
+
+def _xwc(m=512, k=32, n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(m, k), jnp.float32) * 0.5
+    w = jnp.asarray(rng.randn(k, n), jnp.float32) * 0.2
+    c = jnp.asarray(rng.randn(n), jnp.float32) * 0.1
+    return x, w, c
+
+
+@pytest.mark.parametrize("force", ["dense", "interpret"])
+def test_matmul_colstats_matches_reference(force):
+    x, w, c = _xwc()
+    y, s1, s2 = MS.matmul_colstats(x, w, c, force=force)
+    ref = np.asarray(x) @ np.asarray(w)
+    yc = ref - np.asarray(c)[None, :]
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), yc.sum(0), rtol=1e-3,
+                               atol=1e-2)
+    np.testing.assert_allclose(np.asarray(s2), (yc * yc).sum(0),
+                               rtol=1e-3, atol=1e-2)
+
+
+@pytest.mark.parametrize("force", ["dense", "interpret"])
+def test_matmul_colstats_grads(force):
+    x, w, c = _xwc(m=512, k=16, n=128, seed=1)
+
+    def loss(x, w):
+        y, s1, s2 = MS.matmul_colstats(x, w, c, force=force)
+        # touch all three outputs so every cotangent path is exercised
+        return (jnp.sum(y ** 2) + jnp.sum(s1 * 0.3)
+                + jnp.sum(jnp.sqrt(s2 + 1.0)))
+
+    def loss_ref(x, w):
+        y = x @ w
+        yc = y - c[None, :]
+        return (jnp.sum(y ** 2) + jnp.sum(jnp.sum(yc, 0) * 0.3)
+                + jnp.sum(jnp.sqrt(jnp.sum(yc * yc, 0) + 1.0)))
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=1e-3, atol=1e-3)
+
+
+def _train_conv_bn(monkeypatch, fuse, stride=1, steps=3):
+    """Tiny 1x1-conv + BN + loss net; returns per-step losses and the
+    final conv filter (fusion on CPU takes the dense matmul_colstats
+    path — same algebra as the Pallas kernel)."""
+    monkeypatch.setenv("PADDLE_TPU_FUSE_CONV_BN", "1" if fuse else "0")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    scope = fluid.Scope()
+    from paddle_tpu.core import unique_name
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard("fz%d_" % (1 if fuse else 0)):
+        x = fluid.layers.data("x", [8, 8, 8])
+        conv = fluid.layers.conv2d(x, num_filters=16, filter_size=1,
+                                   stride=stride, padding=0,
+                                   bias_attr=False)
+        bn = fluid.layers.batch_norm(conv, act="relu")
+        loss = fluid.layers.reduce_mean(fluid.layers.square(bn))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(3).randn(4, 8, 8, 8).astype(np.float32)
+        losses = []
+        for _ in range(steps):
+            l, = exe.run(feed={"x": xv}, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+        wname = [v.name for v in main.global_block().vars.values()
+                 if v.persistable and ".w" in v.name][0]
+        wv = np.array(np.asarray(scope.find_var(wname)))
+        mvars = sorted(v.name for v in main.global_block().vars.values()
+                       if v.persistable and "mean" in v.name)
+        mv = np.array(np.asarray(scope.find_var(mvars[0]))) if mvars \
+            else None
+    return losses, wv, mv
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+def test_conv_bn_fusion_parity(monkeypatch, stride):
+    """The fused 1x1-conv+BN stat path trains identically to the
+    composed path: per-step losses, final weights and the BN running
+    mean all match."""
+    l0, w0, m0 = _train_conv_bn(monkeypatch, fuse=False, stride=stride)
+    l1, w1, m1 = _train_conv_bn(monkeypatch, fuse=True, stride=stride)
+    np.testing.assert_allclose(l1, l0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+    if m0 is not None:
+        np.testing.assert_allclose(m1, m0, rtol=1e-4, atol=1e-6)
+
+
+def test_fusion_leaves_3x3_and_test_mode_alone(monkeypatch):
+    """Non-1x1 convs and inference-mode programs keep the composed
+    path (no stash ever created)."""
+    monkeypatch.setenv("PADDLE_TPU_FUSE_CONV_BN", "1")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", [4, 6, 6])
+        conv = fluid.layers.conv2d(x, num_filters=8, filter_size=3,
+                                   padding=1, bias_attr=False)
+        bn = fluid.layers.batch_norm(conv)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(2, 4, 6, 6).astype(np.float32)
+        out, = exe.run(feed={"x": xv}, fetch_list=[bn])
+    assert np.isfinite(np.asarray(out)).all()
