@@ -45,7 +45,7 @@ def _flag_on(name):
     return bool(flags.get_flag(name.replace("PADDLE_TPU_", "").lower()))
 
 
-def _normalize_feeds(feed):
+def _normalize_feeds(feed, accum_steps=1):
     """LoDTensor/array feeds → (feed_arrays, static_info).
 
     Sequence (LoD) feeds become FLAT row buffers + ``<name>@LOD`` length
@@ -59,24 +59,57 @@ def _normalize_feeds(feed):
     over flat LoD rows should disable via PADDLE_TPU_LOD_BUCKETING=0.
     static_info additionally carries ``<name>@MAXLEN`` — the bucketed max
     per-sequence length that bounds scan depth in the RNN packers.
+
+    accum_steps > 1: LoD feeds are pre-split HOST-SIDE into that many
+    microbatches of equal SEQUENCE count (the ragged split is
+    data-dependent, so it cannot happen inside the jit): the flat buffer
+    becomes [k, bucket, ...] (every microbatch zero-padded to one shared
+    bucketed total) and the lengths [k, n_seqs/k]; static_info marks the
+    feed ``<name>@ACCUM_LOD`` so the accumulation scan indexes
+    microbatch i instead of reshape-chunking a dense batch dim.
     """
     feed_arrays, feed_lods, static_info = {}, {}, {}
     bucket_on = _flag_on("PADDLE_TPU_LOD_BUCKETING")
+    k_acc = max(1, int(accum_steps))
     for k, v in feed.items():
         if isinstance(v, LoDTensor):
             arr = v.data
             if v.lod:
                 # sequence ops consume per-sequence LENGTHS (not offsets)
-                lengths = v.recursive_sequence_lengths()[-1]
-                feed_lods[k + "@LOD"] = np.asarray(lengths, np.int32)
-                mx = max(1, int(max(lengths, default=1)))
+                lengths = np.asarray(
+                    v.recursive_sequence_lengths()[-1], np.int32)
+                mx = max(1, int(lengths.max(initial=1)))
                 static_info[k + "@MAXLEN"] = 1 << (mx - 1).bit_length()
-                total = int(arr.shape[0])
-                bucket = 1 << max(0, int(total - 1).bit_length())
-                if bucket_on and bucket > total:
-                    pad = np.zeros((bucket - total,) + arr.shape[1:],
-                                   arr.dtype)
-                    arr = np.concatenate([arr, pad], axis=0)
+                if k_acc > 1:
+                    if len(lengths) % k_acc:
+                        raise ValueError(
+                            "feed %r has %d sequences, not divisible "
+                            "into %d accumulation microbatches"
+                            % (k, len(lengths), k_acc))
+                    per = len(lengths) // k_acc
+                    offs = np.concatenate(
+                        [[0], np.cumsum(lengths)]).astype(np.int64)
+                    totals = [int(offs[(g + 1) * per] - offs[g * per])
+                              for g in range(k_acc)]
+                    bucket = max(1, max(totals))
+                    if bucket_on:
+                        bucket = 1 << max(0, int(bucket - 1).bit_length())
+                    stacked = np.zeros((k_acc, bucket) + arr.shape[1:],
+                                       arr.dtype)
+                    for g in range(k_acc):
+                        stacked[g, :totals[g]] = \
+                            arr[offs[g * per]:offs[(g + 1) * per]]
+                    feed_lods[k + "@LOD"] = lengths.reshape(k_acc, per)
+                    static_info[k + "@ACCUM_LOD"] = True
+                    arr = stacked
+                else:
+                    feed_lods[k + "@LOD"] = lengths
+                    total = int(arr.shape[0])
+                    bucket = 1 << max(0, int(total - 1).bit_length())
+                    if bucket_on and bucket > total:
+                        pad = np.zeros((bucket - total,) + arr.shape[1:],
+                                       arr.dtype)
+                        arr = np.concatenate([arr, pad], axis=0)
             feed_arrays[k] = arr
         else:
             feed_arrays[k] = np.asarray(v) \
@@ -205,9 +238,13 @@ class Executor:
             return result
 
         from ..amp import amp_enabled
+        from ..flags import get_flag
         check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
+        # every toggle the lowering consults at trace time must key the
+        # cache, or flipping it after a run silently reuses a stale trace
         key = (program, program._version, _feed_signature(feed_arrays),
                fetch_names, state_keys, amp_enabled(), check_nan,
+               get_flag("fuse_conv_bn"),
                tuple(sorted(static_info.items())))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
@@ -525,7 +562,8 @@ class Executor:
         accumulating mean grads (and streaming persistable-state updates,
         e.g. batch-norm counters), then the optimizer ops apply once.
         In-graph, so one XLA executable per step regardless of
-        accum_steps. Requires a grad marker and non-LoD feeds; only
+        accum_steps. Requires a grad marker; LoD feeds are supported via
+        the host-side [k, ...] pre-split (_normalize_feeds). Only
         targets and persistables are fetchable (microbatch intermediates
         never leave the scan)."""
         static_info = static_info or {}
@@ -552,10 +590,9 @@ class Executor:
                 raise NotImplementedError(
                     "gradient accumulation supports loss training "
                     "(append_backward) only, not calc_gradient")
-            if any(n.endswith("@LOD") for n in feed_names):
-                raise ValueError(
-                    "gradient accumulation does not support LoD feeds "
-                    "(ragged microbatch splits are data-dependent)")
+            # LoD feeds arrive pre-split host-side ([k, ...] stacked by
+            # _normalize_feeds(accum_steps=k)) and are scanned by index
+            # — see static_info @ACCUM_LOD in _lower_with_grad_accum
 
         def step(state, feeds, rng_key):
             n_splits = [0]
@@ -738,9 +775,19 @@ class Executor:
                    for ns in o.inputs.values() for n in ns}
 
         k = int(accum_steps)
+        static_info = getattr(ctx, "static_info", None) or {}
+        # LoD feeds (and their @LOD lengths) were pre-split host-side
+        # into [k, ...] stacks by _normalize_feeds(accum_steps=k): scan
+        # them by leading index instead of reshape-chunking a batch dim
+        stacked = {n for n in feeds if static_info.get(n + "@ACCUM_LOD")}
+        stacked |= {n + "@LOD" for n in list(stacked)
+                    if n + "@LOD" in feeds}
         chunked = {}
         for n in feeds:
             v = base_env[n]
+            if n in stacked:
+                chunked[n] = v                 # already [k, ...]
+                continue
             if getattr(v, "ndim", 0) < 1 or v.shape[0] <= 1:
                 continue          # scalar/broadcast feed: replicate
             if v.shape[0] % k:

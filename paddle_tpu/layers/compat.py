@@ -19,7 +19,7 @@ __all__ = [
     "detection_output", "detection_map", "create_parameter",
     "autoincreased_step_counter", "shrink_memory",
     "reorder_lod_tensor_by_rank", "batch", "shuffle", "double_buffer",
-    "open_recordio_file", "ConditionalBlock",
+    "open_recordio_file", "open_files", "ConditionalBlock",
     "multi_box_head", "ssd_loss",
 ]
 
@@ -391,12 +391,22 @@ def open_recordio_file(filename, shapes=None, lod_levels=None,
     """Host reader over the native chunked record format
     (create_recordio_file_reader_op capability)."""
     from .. import recordio
+    return recordio.reader(filename)
 
-    def _reader():
-        for rec in recordio.reader(filename):
-            yield rec
 
-    return _reader
+def open_files(filenames, shapes=None, lod_levels=None, dtypes=None,
+               thread_num=1, buffer_size=64, pass_num=1, **kwargs):
+    """Multi-file threaded recordio ingestion (layers/io.py:360 +
+    operators/reader/open_files_op.cc capability): returns a host
+    reader-creator scanning the files with `thread_num` prefetch
+    threads; shapes/lod_levels/dtypes are accepted for signature parity
+    (samples carry their own shapes in the record codec). File-shard
+    kwargs (shard_id/num_shards) pass through — the multi-host input
+    path where each host reads its file subset."""
+    from ..reader import open_files as _open_files
+    return _open_files(filenames, thread_num=thread_num,
+                       buffer_size=buffer_size, pass_num=pass_num,
+                       **kwargs)
 
 
 class ConditionalBlock:

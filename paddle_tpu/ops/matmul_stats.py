@@ -169,7 +169,8 @@ def matmul_colstats(x, w, c, force=None):
     return _mmstats(x, w, c, path)
 
 
-# pallas imports at the end so CPU-only environments that never take the
-# kernel path still import this module
+# pallas imports at the end, matching ops/flash_attention.py's layout:
+# kernel definitions above reference pl/pltpu at TRACE time only, so the
+# module reads top-to-bottom with the public API before the backend glue
 from jax.experimental import pallas as pl                    # noqa: E402
 from jax.experimental.pallas import tpu as pltpu             # noqa: E402

@@ -100,7 +100,8 @@ class ParallelExecutor:
         # data-dependent); GSPMD re-shards downstream. _normalize_feeds also
         # buckets the flat LoD totals so signatures stay cache-stable.
         from ..core.executor import _normalize_feeds
-        feed_arrays, static_info = _normalize_feeds(feed)
+        feed_arrays, static_info = _normalize_feeds(
+            feed, accum_steps=self._accum_steps)
         lod_keys = {k for k in feed_arrays if k.endswith("@LOD")}
         lod_keys |= {k for k, v in feed.items() if isinstance(v, LoDTensor)}
         for k, v in feed_arrays.items():
@@ -125,9 +126,11 @@ class ParallelExecutor:
         check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
         use_amp = self._force_bf16 if self._force_bf16 is not None \
             else amp_enabled()
+        from ..flags import get_flag
         key = (program, program._version, _feed_signature(feed_arrays),
                fetch_names, state_keys, hints, check_nan, use_amp,
-               self._accum_steps, tuple(sorted(static_info.items())))
+               self._accum_steps, get_flag("fuse_conv_bn"),
+               tuple(sorted(static_info.items())))
         entry = self._cache.get(key)
         repl = NamedSharding(self.mesh, PartitionSpec())
         if entry is None:

@@ -12,7 +12,7 @@ import random as _random
 import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
-           "firstn", "xmap_readers", "batch", "cache",
+           "firstn", "xmap_readers", "batch", "cache", "open_files",
            "ComposeNotAligned"]
 
 
@@ -189,6 +189,122 @@ def batch(reader, batch_size, drop_last=False):
         if b and not drop_last:
             yield b
     return batch_reader
+
+
+def open_files(filenames, thread_num=1, buffer_size=64, shard_id=None,
+               num_shards=None, shuffle_files=False, seed=0,
+               pass_num=1):
+    """Multi-file recordio ingestion (reference layers/io.py:360
+    open_files / operators/reader/open_files_op.cc parity, reshaped for
+    the TPU data plane: a reader-creator instead of a graph reader op —
+    feed it through paddle.batch / DataFeeder / DeviceLoader).
+
+    * ``filenames``: list of recordio files (each written by
+      recordio.convert_reader_to_recordio_file).
+    * ``thread_num`` reader threads scan DISJOINT file subsets
+      concurrently, decoding into one bounded queue (the reference's
+      multi-threaded buffered reader). Samples interleave across files;
+      order is nondeterministic when thread_num > 1, exactly like the
+      reference's open_files without order preservation.
+    * ``shard_id``/``num_shards``: keep only files [shard_id::num_shards]
+      — the MULTI-HOST input story (each host reads its shard; defaults
+      to jax.process_index()/process_count() when either is None and
+      jax is multi-process).
+    * ``shuffle_files``: shuffle the file order each pass (seeded).
+    * ``pass_num``: repeat the whole file set that many times.
+    """
+    from ..recordio import reader as _file_reader
+    filenames = list(filenames)
+    if not filenames:
+        raise ValueError("open_files: empty file list")
+    if shard_id is None or num_shards is None:
+        try:
+            import jax
+            if jax.process_count() > 1:
+                shard_id = jax.process_index() \
+                    if shard_id is None else shard_id
+                num_shards = jax.process_count() \
+                    if num_shards is None else num_shards
+        except Exception:
+            pass
+    if num_shards and num_shards > 1:
+        mine = filenames[int(shard_id or 0)::int(num_shards)]
+        if not mine:
+            raise ValueError(
+                "open_files: shard %s of %s gets no files out of %d"
+                % (shard_id, num_shards, len(filenames)))
+        filenames = mine
+
+    end = object()
+    invocation = [0]          # distinct shuffle order per epoch/call
+
+    def data_reader():
+        inv = invocation[0]
+        invocation[0] += 1
+        rng = _random.Random(seed + inv)
+        for _ in range(max(1, int(pass_num))):
+            files = list(filenames)
+            if shuffle_files:
+                rng.shuffle(files)
+            n_thr = max(1, min(int(thread_num), len(files)))
+            out_q = queue.Queue(buffer_size)
+            stop = threading.Event()
+
+            def _put(item):
+                # bounded put that gives up when the consumer abandoned
+                # the pass, so no worker blocks forever on a full queue
+                while not stop.is_set():
+                    try:
+                        out_q.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
+            def scan_worker(my_files):
+                try:
+                    for f in my_files:
+                        it = _file_reader(f)()
+                        try:
+                            for sample in it:
+                                if not _put(sample):
+                                    return      # pass abandoned
+                        finally:
+                            it.close()          # frees the scanner FILE*
+                except BaseException as e:      # propagate, don't truncate
+                    _put((end, e))
+                    return
+                _put((end, None))
+
+            threads = [threading.Thread(
+                target=scan_worker, args=(files[t::n_thr],), daemon=True)
+                for t in range(n_thr)]
+            for t in threads:
+                t.start()
+            try:
+                done = 0
+                while done < n_thr:
+                    sample = out_q.get()
+                    if isinstance(sample, tuple) and len(sample) == 2 \
+                            and sample[0] is end:
+                        if sample[1] is not None:
+                            raise sample[1]     # a scan thread failed
+                        done += 1
+                    else:
+                        yield sample
+            finally:
+                # early abandon (consumer break / error / .close()):
+                # release blocked putters and reap the threads
+                stop.set()
+                try:
+                    while True:
+                        out_q.get_nowait()
+                except queue.Empty:
+                    pass
+                for t in threads:
+                    t.join(timeout=5.0)
+
+    return data_reader
 
 
 from .device_loader import DeviceLoader, repeat_feed  # noqa: F401,E402
