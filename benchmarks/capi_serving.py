@@ -1,0 +1,82 @@
+"""C-API serving latency benchmark (round-4 directive #8a).
+
+Saves a ResNet-50 inference model, then drives it from the PURE-C
+bench_capi binary (pt_predictor_run per call — the deployment path of
+the reference's capi/gradient_machine.h consumers) and reports p50/p99
+per-call latency at bs1 and bs16.
+
+Per-call latency INCLUDES the host->device feed, device->host fetch and
+(on this sandbox) the axon tunnel round-trip — it is the number a
+serving client would observe, not kernel time.
+
+Run: python benchmarks/capi_serving.py [--device TPU|CPU]
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+from common import parse_args, get_place  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.models import resnet  # noqa: E402
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "native")
+
+
+def main():
+    args = parse_args("capi_serving", batch_size=16, iterations=50,
+                      extra=lambda p: p.add_argument(
+                          "--image_size", type=int, default=224))
+    subprocess.run(["make", "-C", NATIVE, "build/libcapi.so",
+                    "build/bench_capi"], check=True, capture_output=True,
+                   text=True)
+    bench = os.path.join(NATIVE, "build", "bench_capi")
+
+    shape = (3, args.image_size, args.image_size)
+    image = fluid.layers.data("data", list(shape))
+    logits = resnet.resnet_imagenet(image, depth=50, num_classes=1000)
+    if args.dtype == "bfloat16":
+        fluid.amp.enable_amp()
+    exe = fluid.Executor(get_place(args))
+    exe.run(fluid.default_startup_program())
+
+    results = {}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model")
+        fluid.io.save_inference_model(path, ["data"], [logits], exe)
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # PREPEND the repo: the inherited PYTHONPATH may carry platform
+        # plugin paths (e.g. this sandbox's axon TPU plugin) the embedded
+        # interpreter needs
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        if args.device == "CPU":
+            env["JAX_PLATFORMS"] = "cpu"
+        for bs in sorted({1, args.batch_size}):
+            out = subprocess.run(
+                [bench, path, "3", str(args.image_size),
+                 str(args.image_size), str(bs), str(args.iterations)],
+                env=env, capture_output=True, text=True, timeout=900)
+            if out.returncode != 0:
+                print("bs%d FAILED: %s" % (bs, out.stderr[-400:]),
+                      file=sys.stderr)
+                continue
+            line = [ln for ln in out.stdout.splitlines()
+                    if ln.startswith("LAT")][0]
+            p50, p99, mean = (float(v) for v in line.split()[1:])
+            results[bs] = (p50, p99, mean)
+            print("bs%-3d p50 %.2f ms  p99 %.2f ms  mean %.2f ms  "
+                  "(%.1f img/s at p50)"
+                  % (bs, p50, p99, mean, bs / p50 * 1000), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

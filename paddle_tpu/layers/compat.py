@@ -141,8 +141,6 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
 
 def nce(input, label, num_total_classes, sample_weight=None,
         param_attr=None, bias_attr=None, num_neg_samples=None):
-    if sample_weight is not None:
-        raise NotImplementedError("nce: sample_weight is not implemented")
     helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
     if input.shape is None or len(input.shape) < 2:
         raise ValueError(
@@ -158,10 +156,14 @@ def nce(input, label, num_total_classes, sample_weight=None,
     cost = helper.create_variable_for_type_inference(input.dtype)
     sl = helper.create_variable_for_type_inference(input.dtype)
     sll = helper.create_variable_for_type_inference("int64")
+    inputs = {"Input": [input], "Label": [label], "Weight": [w],
+              "Bias": [b]}
+    if sample_weight is not None:
+        # per-example cost weight (nce_op.cc:97 sample_weight input)
+        inputs["SampleWeight"] = [sample_weight]
     helper.append_op(
         type="nce",
-        inputs={"Input": [input], "Label": [label], "Weight": [w],
-                "Bias": [b]},
+        inputs=inputs,
         outputs={"Cost": [cost], "SampleLogits": [sl],
                  "SampleLabels": [sll]},
         attrs={"num_total_classes": num_total_classes,
@@ -293,13 +295,13 @@ def box_coder(prior_box, prior_box_var, target_box,
 
 def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                    keep_top_k=200, nms_threshold=0.3, normalized=True,
-                   background_label=0, name=None):
+                   nms_eta=1.0, background_label=0, name=None):
     return _simple("multiclass_nms",
                    {"BBoxes": [bboxes], "Scores": [scores]},
                    {"score_threshold": score_threshold,
                     "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
                     "nms_threshold": nms_threshold,
-                    "normalized": normalized,
+                    "normalized": normalized, "nms_eta": float(nms_eta),
                     "background_label": background_label}, name=name)
 
 
@@ -310,10 +312,7 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     """Reference composition (layers/detection.py detection_output):
     softmax the raw class scores [N, M, C], decode predicted offsets
     against priors, transpose scores to [N, C, M], then multiclass
-    NMS."""
-    if nms_eta != 1.0:
-        raise NotImplementedError(
-            "detection_output: adaptive nms_eta != 1.0 is not implemented")
+    NMS (nms_eta < 1 = adaptive threshold decay, detection.py:54)."""
     from .nn import softmax
     from .tensor import transpose
     probs = transpose(softmax(scores), perm=[0, 2, 1])
@@ -322,7 +321,7 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     return multiclass_nms(decoded, probs,
                           score_threshold=score_threshold,
                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
-                          nms_threshold=nms_threshold,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
                           background_label=background_label)
 
 

@@ -1,0 +1,87 @@
+/* C serving latency benchmark (round-4 directive #8): load a saved
+ * inference model through the C ABI and measure per-call latency of
+ * pt_predictor_run — the deployment-path number the reference's
+ * capi/gradient_machine.h consumers would see.
+ * Usage: bench_capi <model_dir> <c> <h> <w> <batch> <iters>
+ * Prints "LAT <p50_ms> <p99_ms> <mean_ms>" over iters calls after 3
+ * warmup calls. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+extern void* pt_predictor_create(const char* model_dir);
+extern int pt_predictor_run(void* p, const float* in, const int64_t* shape,
+                            int nd, float* out, int64_t out_cap,
+                            int64_t* out_shape, int* out_nd);
+extern void pt_predictor_destroy(void* p);
+extern const char* pt_last_error(void);
+
+static double now_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1e3 + ts.tv_nsec / 1e6;
+}
+
+static int cmp_d(const void* a, const void* b) {
+  double x = *(const double*)a, y = *(const double*)b;
+  return (x > y) - (x < y);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 7) {
+    fprintf(stderr, "usage: %s <model_dir> <c> <h> <w> <batch> <iters>\n",
+            argv[0]);
+    return 2;
+  }
+  int64_t c = atoll(argv[2]), h = atoll(argv[3]), w = atoll(argv[4]);
+  int64_t batch = atoll(argv[5]);
+  int iters = atoi(argv[6]);
+  if (batch < 1 || iters < 1 || c < 1 || h < 1 || w < 1) {
+    fprintf(stderr, "bad arguments\n");
+    return 2;
+  }
+  void* p = pt_predictor_create(argv[1]);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", pt_last_error());
+    return 1;
+  }
+  int64_t n_in = batch * c * h * w;
+  float* in = (float*)malloc(n_in * sizeof(float));
+  for (int64_t i = 0; i < n_in; ++i) in[i] = (float)(i % 7) * 0.1f;
+  int64_t shape[4] = {batch, c, h, w};
+  int64_t out_cap = batch * 8192;
+  float* out = (float*)malloc(out_cap * sizeof(float));
+  int64_t out_shape[8];
+  int out_nd = 0;
+  for (int i = 0; i < 3; ++i) { /* warmup + compile */
+    if (pt_predictor_run(p, in, shape, 4, out, out_cap, out_shape,
+                         &out_nd)) {
+      fprintf(stderr, "warmup run failed: %s\n", pt_last_error());
+      return 1;
+    }
+  }
+  double* lat = (double*)malloc(iters * sizeof(double));
+  double sum = 0.0;
+  for (int i = 0; i < iters; ++i) {
+    double t0 = now_ms();
+    if (pt_predictor_run(p, in, shape, 4, out, out_cap, out_shape,
+                         &out_nd)) {
+      fprintf(stderr, "run failed: %s\n", pt_last_error());
+      return 1;
+    }
+    lat[i] = now_ms() - t0;
+    sum += lat[i];
+  }
+  qsort(lat, iters, sizeof(double), cmp_d);
+  printf("LAT %.3f %.3f %.3f\n", lat[iters / 2],
+         lat[(int)(iters * 0.99) < iters ? (int)(iters * 0.99)
+                                         : iters - 1],
+         sum / iters);
+  free(lat);
+  free(in);
+  free(out);
+  pt_predictor_destroy(p);
+  return 0;
+}

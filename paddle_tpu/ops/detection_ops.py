@@ -214,23 +214,33 @@ def _mine_hard_examples(ctx, op):
 
 
 def _nms_single_class(boxes, scores, score_thresh, nms_thresh, top_k,
-                      offset=0.0):
-    """boxes [M,4], scores [M] → keep mask [M] after greedy NMS."""
+                      offset=0.0, eta=1.0):
+    """boxes [M,4], scores [M] → keep mask [M] after greedy NMS.
+
+    eta < 1 is ADAPTIVE NMS (multiclass_nms_op.cc NMSFast /
+    detection.py:54 nms_eta): after every kept box the threshold decays
+    by eta while it stays above 0.5 — later (lower-score) boxes face an
+    ever stricter overlap bar."""
     m = boxes.shape[0]
     valid = scores > score_thresh
     order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
     iou = _iou_matrix(boxes, boxes, offset)
 
-    def body(i, keep):
+    def body(i, carry):
+        keep, th = carry
         cand = order[i]
         ok = valid[cand]
         # suppressed if high IoU with any already-kept higher-score box
-        sup = jnp.any(keep & (iou[cand] > nms_thresh))
-        keep = keep.at[cand].set(jnp.logical_and(ok, ~sup))
-        return keep
+        sup = jnp.any(keep & (iou[cand] > th))
+        kept_now = jnp.logical_and(ok, ~sup)
+        keep = keep.at[cand].set(kept_now)
+        if eta < 1.0:
+            th = jnp.where(kept_now & (th > 0.5), th * eta, th)
+        return keep, th
 
     keep = jnp.zeros((m,), bool)
-    keep = lax.fori_loop(0, m if top_k < 0 else min(m, top_k), body, keep)
+    keep, _ = lax.fori_loop(0, m if top_k < 0 else min(m, top_k), body,
+                            (keep, jnp.float32(nms_thresh)))
     return keep
 
 
@@ -247,6 +257,7 @@ def _multiclass_nms(ctx, op):
     keep_top_k = int(op.attr("keep_top_k", 100))
     background = int(op.attr("background_label", 0))
     offset = 0.0 if op.attr("normalized", True) else 1.0
+    eta = float(op.attr("nms_eta", 1.0) or 1.0)
 
     def per_image(b, s):
         c, m = s.shape
@@ -255,7 +266,7 @@ def _multiclass_nms(ctx, op):
             if cls == background:
                 continue
             keep = _nms_single_class(b, s[cls], score_thresh, nms_thresh,
-                                     nms_top_k, offset)
+                                     nms_top_k, offset, eta)
             sc = jnp.where(keep, s[cls], -1.0)
             lbl = jnp.full((m,), cls, jnp.float32)
             outs.append(jnp.concatenate(

@@ -185,6 +185,12 @@ def _nce(ctx, op):
     logits = logits - jnp.log(jnp.asarray(num_classes, jnp.float32))
     per = jnp.maximum(logits, 0) - logits * labels01 + jnp.log1p(
         jnp.exp(-jnp.abs(logits)))
-    ctx.set_out(op, "Cost", jnp.sum(per, axis=1, keepdims=True))
+    cost = jnp.sum(per, axis=1, keepdims=True)
+    if op.input("SampleWeight"):
+        # per-example weight scales the example's whole cost
+        # (nce_op.cc:97 sample_weight)
+        swt = ctx.in1(op, "SampleWeight").reshape(batch, 1)
+        cost = cost * swt.astype(cost.dtype)
+    ctx.set_out(op, "Cost", cost)
     ctx.set_out(op, "SampleLogits", logits)
     ctx.set_out(op, "SampleLabels", samples)

@@ -256,3 +256,34 @@ def test_chunk_eval_iob():
                   fetch_list=[outs["ni"], outs["nl"], outs["nc"]])
     ni, nl, nc = [int(np.asarray(g).reshape(-1)[0]) for g in got]
     assert ni == 2 and nl == 2 and nc == 1
+
+
+def test_multiclass_nms_adaptive_eta_tightens_threshold():
+    """nms_eta < 1 (detection.py:54 / multiclass_nms_op.cc NMSFast):
+    the overlap threshold decays after each kept box, so a box that
+    SURVIVES plain NMS is suppressed under adaptive NMS."""
+    # three boxes: A (top score), B overlaps A with IoU ~0.55, C far
+    boxes = np.array([[[0, 0, 1, 1], [0, 0.3, 1, 1.42],
+                       [2, 2, 3, 3]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+
+    def run(eta, tag):
+        out, = _one_op("multiclass_nms",
+                       {"BBoxes": (tag + "b", (1, 3, 4), "float32"),
+                        "Scores": (tag + "s", (1, 2, 3), "float32")},
+                       {"Out": tag + "o"},
+                       {"score_threshold": 0.1, "nms_threshold": 0.6,
+                        "keep_top_k": 3, "background_label": 0,
+                        "nms_eta": eta},
+                       {tag + "b": boxes, tag + "s": scores},
+                       [tag + "o"])
+        return out[0][out[0][:, 1] > 0]
+
+    # IoU(A,B) ~ 0.52 < 0.6: plain NMS keeps all three
+    assert run(1.0, "p").shape[0] == 3
+    # eta=0.8: after keeping A the threshold drops to 0.48 < 0.52 -> B
+    # is suppressed; C (far away) still kept
+    kept = run(0.8, "a")
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], rtol=1e-6)

@@ -354,3 +354,41 @@ def test_split_ids_and_selected_rows_ops():
     g1, g2 = exe.run(feed={"ids": ids}, fetch_list=[o1, o2])
     np.testing.assert_array_equal(np.asarray(g1).reshape(-1), [0, 4])
     np.testing.assert_array_equal(np.asarray(g2).reshape(-1), [3, 7])
+
+
+def test_chunk_parallel_push_roundtrip(monkeypatch):
+    """Large values pushed over chunk-parallel side streams arrive
+    intact (forced on regardless of core count: the path must be
+    correct wherever it is enabled), for PUT, tagged SEND, and
+    SelectedRows."""
+    from paddle_tpu.distributed import rpc as rpc_mod
+    from paddle_tpu.core.selected_rows import SelectedRows
+    monkeypatch.setattr(rpc_mod, "_CHUNK_THRESHOLD", 1 << 10)
+    monkeypatch.setattr(rpc_mod, "_CHUNK_STREAMS", 3)
+    applied = []
+
+    def opt(store, grads):
+        applied.append({k: v for k, v in grads.items()})
+
+    server = rpc_mod.VariableServer(fan_in=1, optimize_fn=opt).start()
+    cli = rpc_mod.RPCClient("127.0.0.1:%d" % server.port)
+    try:
+        w = np.arange(300_000, dtype=np.float32).reshape(500, 600)
+        cli.put_var("w", w)
+        np.testing.assert_array_equal(cli.get_var("w"), w)
+        cli.send_var("w@GRAD", 2 * w, tag="t0:iaaa:s0")
+        cli.barrier(tag="t0:iaaa:s0")
+        assert len(applied) == 1
+        np.testing.assert_array_equal(
+            np.asarray(applied[0]["w@GRAD"]), 2 * w)
+        sr = SelectedRows(np.arange(400, dtype=np.int64),
+                          np.ones((400, 700), np.float32), 100000)
+        cli.send_var("emb@GRAD", sr)
+        with server._lock:
+            got = list(server.grads["emb@GRAD"].values())[0]
+        np.testing.assert_array_equal(np.asarray(got.rows), sr.rows)
+        np.testing.assert_array_equal(np.asarray(got.value), sr.value)
+        assert not server._pending_chunks      # transfers fully consumed
+    finally:
+        cli.shutdown_server()
+        cli.close()

@@ -242,3 +242,37 @@ def test_create_parameter_counter_print_nce():
         "l": np.zeros((2, 1), np.int64)})
     assert np.asarray(vals[0]).shape == (3, 2)
     assert np.asarray(vals[3]).shape[0] == 2
+
+
+def test_nce_sample_weight_scales_cost():
+    """nce sample_weight (nce_op.cc:97): per-example weights scale each
+    example's cost; weight 0 silences an example entirely."""
+    emb = fluid.layers.data("e2", [8])
+    lbl = fluid.layers.data("l2", [1], dtype="int64")
+    swt = fluid.layers.data("sw", [1])
+    prog = fluid.default_main_program()
+    prog.random_seed = 7
+    cost = fluid.layers.nce(emb, lbl, num_total_classes=6,
+                            num_neg_samples=2, sample_weight=swt,
+                            param_attr=fluid.ParamAttr(name="ncew"),
+                            bias_attr=fluid.ParamAttr(name="nceb"))
+    ev = np.ones((3, 8), np.float32)
+    lv = np.zeros((3, 1), np.int64)
+
+    def run_fresh(sw):
+        # fresh executor+scope per run -> identical RNG stream, so the
+        # drawn negatives match and only the weights differ
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            out, = exe.run(feed={"e2": ev, "l2": lv, "sw": sw},
+                           fetch_list=[cost])
+        return np.asarray(out)
+
+    base = run_fresh(np.ones((3, 1), np.float32))
+    scaled = run_fresh(np.array([[1.], [2.], [0.]], np.float32))
+    assert base.shape == (3, 1)
+    np.testing.assert_allclose(scaled[0], base[0], rtol=1e-6)
+    np.testing.assert_allclose(scaled[1], 2 * base[1], rtol=1e-5)
+    np.testing.assert_allclose(scaled[2], 0.0, atol=1e-7)
