@@ -23,13 +23,17 @@ Usage (reference book v2 shape):
 """
 
 from .. import batch, reader, dataset  # noqa: F401  (reader plumbing)
+from . import activation  # noqa: F401
+from . import attr  # noqa: F401
 from . import data_type  # noqa: F401
 from . import event  # noqa: F401
 from . import inference  # noqa: F401
 from . import layer  # noqa: F401
+from . import networks  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import parameters as _parameters_mod
 from . import plot  # noqa: F401
+from . import pooling  # noqa: F401
 from . import trainer  # noqa: F401
 from .inference import infer  # noqa: F401
 

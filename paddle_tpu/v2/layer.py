@@ -19,11 +19,22 @@ def data(name, type, **kwargs):
     return var
 
 
-def fc(input, size, act=None, **kwargs):
+def fc(input, size, act=None, param_attr=None, bias_attr=None, **kwargs):
     act_name = _act_name(act)
     if isinstance(input, (list, tuple)):
         input = fluid_layers.concat(list(input), axis=1)
-    return fluid_layers.fc(input, size, act=act_name)
+    return fluid_layers.fc(input, size, act=act_name,
+                           param_attr=_param_attr(param_attr),
+                           bias_attr=bias_attr
+                           if bias_attr in (None, False)
+                           else _param_attr(bias_attr))
+
+
+def _param_attr(attr):
+    """v2 attr.Param → fluid ParamAttr (pass ParamAttr/None through)."""
+    if attr is None or not hasattr(attr, "to_param_attr"):
+        return attr
+    return attr.to_param_attr()
 
 
 def embedding(input, size, **kwargs):
@@ -49,8 +60,36 @@ def simple_gru(input, size, **kwargs):
 
 
 def pooling(input, pooling_type="max", **kwargs):
-    name = pooling_type if isinstance(pooling_type, str) else "max"
-    return fluid_layers.sequence_pool(input, name.lower())
+    from .pooling import pool_name
+    return fluid_layers.sequence_pool(input, pool_name(pooling_type))
+
+
+def img_conv(input, filter_size, num_filters, num_channel=None,
+             stride=1, padding=0, act=None, **kwargs):
+    """v2 paddle.layer.img_conv (trainer_config_helpers
+    img_conv_layer:2510 capability)."""
+    from .networks import _to_chw
+    return fluid_layers.conv2d(
+        _to_chw(input, num_channel), num_filters=num_filters,
+        filter_size=filter_size, stride=stride, padding=padding,
+        act=_act_name(act))
+
+
+def img_pool(input, pool_size, pool_type=None, stride=1, padding=0,
+             **kwargs):
+    """v2 paddle.layer.img_pool (img_pool_layer:2728 capability)."""
+    from .pooling import pool_name
+    return fluid_layers.pool2d(
+        input, pool_size=pool_size,
+        pool_type=pool_name(pool_type, aliases={"average": "avg"},
+                            allowed=("max", "avg")),
+        pool_stride=stride, pool_padding=padding)
+
+
+def max_id(input, **kwargs):
+    """v2 paddle.layer.max_id: argmax over the class dim (the book
+    scripts' inference head)."""
+    return fluid_layers.argmax(input, axis=-1)
 
 
 def first_seq(input, **kwargs):

@@ -4,9 +4,34 @@ carry the config until the trainer appends the real fluid optimizer ops."""
 from .. import optimizer as fluid_optimizer
 
 
+class L2Regularization:
+    """v2 paddle.optimizer.L2Regularization(rate=...) — maps onto the
+    fluid L2Decay regularizer at optimizer-build time."""
+
+    def __init__(self, rate=0.0):
+        self.rate = float(rate)
+
+    def to_fluid(self):
+        from ..regularizer import L2Decay
+        return L2Decay(self.rate) if self.rate else None
+
+
+class ModelAverage:
+    """Accepted for v2 script compatibility (sgd.py ModelAverage); the
+    averaging window knobs have no fluid-side effect here."""
+
+    def __init__(self, average_window=0.5, **kwargs):
+        self.average_window = average_window
+
+
 class Optimizer:
-    def __init__(self, **kwargs):
+    def __init__(self, regularization=None, model_average=None, **kwargs):
         self._kwargs = kwargs
+        self.regularization = regularization
+
+    def _reg(self):
+        r = self.regularization
+        return r.to_fluid() if hasattr(r, "to_fluid") else r
 
     def _make(self):
         raise NotImplementedError
@@ -21,7 +46,8 @@ class SGD(Optimizer):
         self.learning_rate = learning_rate
 
     def _make(self):
-        return fluid_optimizer.SGD(learning_rate=self.learning_rate)
+        return fluid_optimizer.SGD(learning_rate=self.learning_rate,
+                                   regularization=self._reg())
 
 
 class Momentum(Optimizer):
@@ -32,7 +58,8 @@ class Momentum(Optimizer):
 
     def _make(self):
         return fluid_optimizer.Momentum(learning_rate=self.learning_rate,
-                                        momentum=self.momentum)
+                                        momentum=self.momentum,
+                                        regularization=self._reg())
 
 
 class Adam(Optimizer):
@@ -45,7 +72,8 @@ class Adam(Optimizer):
     def _make(self):
         return fluid_optimizer.Adam(learning_rate=self.learning_rate,
                                     beta1=self.beta1, beta2=self.beta2,
-                                    epsilon=self.epsilon)
+                                    epsilon=self.epsilon,
+                                    regularization=self._reg())
 
 
 class AdaGrad(Optimizer):
@@ -56,7 +84,8 @@ class AdaGrad(Optimizer):
 
     def _make(self):
         return fluid_optimizer.Adagrad(learning_rate=self.learning_rate,
-                                       epsilon=self.epsilon)
+                                       epsilon=self.epsilon,
+                                       regularization=self._reg())
 
 
 class RMSProp(Optimizer):
@@ -68,4 +97,5 @@ class RMSProp(Optimizer):
 
     def _make(self):
         return fluid_optimizer.RMSProp(learning_rate=self.learning_rate,
-                                       rho=self.rho, epsilon=self.epsilon)
+                                       rho=self.rho, epsilon=self.epsilon,
+                                       regularization=self._reg())

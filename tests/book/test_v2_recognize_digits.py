@@ -1,0 +1,98 @@
+"""Book test: recognize_digits through the paddle.v2 API, written in
+the canonical v2 script shape (reference capability:
+python/paddle/v2/* driving the recognize_digits book chapter — data
+layers with data_type, activation objects, networks.simple_img_conv_pool,
+parameters.create, trainer.SGD event loop, paddle.infer). Both the MLP
+and the convnet variants must train.
+
+L9 closure (round-4 directive #6): this and
+test_v2_understand_sentiment.py are the 'two reference v2 book scripts
+run nearly-verbatim' evidence for COVERAGE's L9 row."""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def softmax_regression(img):
+    predict = paddle.layer.fc(input=img, size=10,
+                              act=paddle.activation.Softmax())
+    return predict
+
+
+def multilayer_perceptron(img):
+    hidden1 = paddle.layer.fc(input=img, size=64,
+                              act=paddle.activation.Relu())
+    hidden2 = paddle.layer.fc(input=hidden1, size=32,
+                              act=paddle.activation.Relu())
+    predict = paddle.layer.fc(input=hidden2, size=10,
+                              act=paddle.activation.Softmax())
+    return predict
+
+
+def convolutional_neural_network(img):
+    conv_pool_1 = paddle.networks.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, num_channel=1,
+        pool_size=2, pool_stride=2, act=paddle.activation.Relu())
+    conv_pool_2 = paddle.networks.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, num_channel=8,
+        pool_size=2, pool_stride=2, act=paddle.activation.Relu())
+    predict = paddle.layer.fc(input=conv_pool_2, size=10,
+                              act=paddle.activation.Softmax())
+    return predict
+
+
+def _train(net_fn, passes=4, lr=0.05):
+    import paddle_tpu as fluid
+    fluid.switch_main_program(fluid.Program())
+    fluid.switch_startup_program(fluid.Program())
+
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data(
+        name="pixel", type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(10))
+    predict = net_fn(images)
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(
+        learning_rate=lr / 128.0, momentum=0.9,
+        regularization=paddle.optimizer.L2Regularization(rate=5e-4))
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    costs = []
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.EndPass):
+            costs.append(event.cost)
+
+    trainer.train(
+        reader=paddle.batch(
+            paddle.reader.shuffle(paddle.dataset.mnist.train(n=512),
+                                  buf_size=512),
+            batch_size=64),
+        num_passes=passes, event_handler=event_handler)
+    assert costs[-1] < costs[0], costs
+
+    # paddle.infer over the test split (book-script inference shape)
+    test_data = [(s[0],) for s in paddle.dataset.mnist.test(n=32)()]
+    probs = paddle.infer(output_layer=predict, parameters=parameters,
+                         input=test_data)
+    probs = np.asarray(probs)
+    assert probs.shape == (32, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-3)
+    return costs
+
+
+def test_v2_recognize_digits_mlp():
+    _train(multilayer_perceptron)
+
+
+def test_v2_recognize_digits_conv():
+    _train(convolutional_neural_network, passes=3)
+
+
+def test_v2_recognize_digits_softmax():
+    _train(softmax_regression)
