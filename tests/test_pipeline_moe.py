@@ -344,3 +344,58 @@ def test_pipeline_interleaved_schedule_parity():
     l_i, w_i = _lm_parallel_loss(st_i, {"dp": 2, "pp": 2}, "qb_")
     np.testing.assert_allclose(l_i, l_g, rtol=2e-4)
     np.testing.assert_allclose(w_i, w_g, rtol=2e-3, atol=2e-5)
+
+
+def test_pipeline_full_composition_pp_tp_sp():
+    """pp x tp x sp in ONE stage body: Megatron-sharded weights with
+    per-sublayer psum AND ring attention over the sequence shard, inside
+    the pipeline shard_map — the deepest composition the stage supports."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    st_pp = parallel.DistributedStrategy(dp=1, pp=2)
+    l_pp, w_pp = _lm_parallel_loss(st_pp, {"dp": 1, "pp": 2}, "fa_")
+    st_all = parallel.DistributedStrategy(dp=1, pp=2, tp=2, sp=2)
+    l_all, w_all = _lm_parallel_loss(
+        st_all, {"dp": 1, "pp": 2, "tp": 2, "sp": 2}, "fb_")
+    np.testing.assert_allclose(l_all, l_pp, rtol=2e-4)
+    np.testing.assert_allclose(w_all, w_pp, rtol=2e-3, atol=2e-5)
+
+
+def test_pipeline_interleaved_with_recompute():
+    """Interleaved virtual stages compose with per-layer recompute
+    (jax.checkpoint inside the chunk body): same trained model as plain
+    gpipe."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+
+    def run(schedule, recompute, prefix):
+        mesh = parallel.make_mesh({"dp": 2, "pp": 2})
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 23
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope), unique_name.guard(prefix):
+            x = fluid.layers.data("x", [8, 16])
+            y = fluid.layers.pipelined_decoder_stack(
+                x, n_layer=4, n_head=2, d_inner=32,
+                schedule=schedule, recompute=recompute)
+            loss = fluid.layers.mean(fluid.layers.square(y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            pexe = fluid.ParallelExecutor(loss_name=loss.name,
+                                          main_program=main, mesh=mesh,
+                                          scope=scope)
+            xv = np.random.RandomState(6).rand(8, 8, 16).astype(
+                np.float32)
+            l, = pexe.run([loss], feed={"x": xv})
+            wname = prefix + "pipeline_stack_0.wq"
+            return (float(np.asarray(l)),
+                    np.asarray(scope.find_var(wname)))
+
+    l_g, w_g = run("gpipe", False, "ra_")
+    l_ir, w_ir = run("interleaved", True, "rb_")
+    np.testing.assert_allclose(l_ir, l_g, rtol=1e-5)
+    np.testing.assert_allclose(w_ir, w_g, rtol=1e-4, atol=1e-6)
