@@ -27,28 +27,26 @@ class DetectionMAP:
         from . import layers
         from .layers.layer_helper import LayerHelper
 
-        # the detection_map lowering implements 11-point AP over all GT
-        # boxes; unsupported knobs are rejected loudly rather than
-        # silently computing a different metric. With the default
-        # evaluate_difficult=True difficult boxes count anyway, so a
-        # provided gt_difficult cannot change the result and is accepted
-        # (class_num likewise — classes come from the label column).
-        if not evaluate_difficult:
-            raise NotImplementedError(
-                "DetectionMAP: excluding difficult ground truth "
-                "(evaluate_difficult=False) is not implemented")
-        if ap_version != "11point":
-            raise NotImplementedError(
-                "DetectionMAP: only ap_version='11point' is implemented")
+        # both AP versions are implemented ("11point" interpolated and
+        # "integral" recall-delta); evaluate_difficult=False excludes
+        # difficult ground truth VOC-style via the gt_difficult column
+        # (class_num is accepted — classes come from the label column)
+        if ap_version not in ("11point", "integral"):
+            raise ValueError(
+                "DetectionMAP: ap_version must be '11point' or "
+                "'integral', got %r" % (ap_version,))
 
         helper = LayerHelper("detection_map_eval")
         label = gt_label if gt_box is None else \
             layers.concat([gt_label, gt_box], axis=1)
+        inputs = {"DetectRes": [input], "Label": [label]}
+        if not evaluate_difficult and gt_difficult is not None:
+            inputs["Difficult"] = [gt_difficult]
         m = helper.create_variable_for_type_inference("float32", shape=(1,))
         acc = helper.create_variable_for_type_inference("int64", shape=(1,))
         helper.append_op(
             type="detection_map",
-            inputs={"DetectRes": [input], "Label": [label]},
+            inputs=inputs,
             outputs={"MAP": [m], "AccumPosCount": [acc]},
             attrs={"overlap_threshold": overlap_threshold,
                    "ap_version": ap_version,

@@ -327,11 +327,25 @@ def detection_output(loc, scores, prior_box, prior_box_var,
 
 def detection_map(detect_res, label, class_num=None, background_label=0,
                   overlap_threshold=0.5, evaluate_difficult=True,
-                  ap_version="11point"):
-    m, _ = _simple("detection_map",
-                   {"DetectRes": [detect_res], "Label": [label]},
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="11point", difficult=None):
+    """VOC mAP over NMS-format detections (detection_map_op.cc).
+    evaluate_difficult=False needs the per-GT `difficult` column input;
+    ap_version: "11point" | "integral"."""
+    if ap_version not in ("11point", "integral"):
+        raise ValueError("detection_map: ap_version must be '11point' "
+                         "or 'integral', got %r" % (ap_version,))
+    if not evaluate_difficult and difficult is None:
+        raise ValueError(
+            "detection_map: evaluate_difficult=False needs the "
+            "`difficult` ground-truth flag input")
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if difficult is not None:
+        inputs["Difficult"] = [difficult]
+    m, _ = _simple("detection_map", inputs,
                    {"overlap_threshold": overlap_threshold,
                     "ap_version": ap_version,
+                    "evaluate_difficult": bool(evaluate_difficult),
                     "background_label": background_label},
                    out_slots=("MAP", "AccumPosCount"))
     return m

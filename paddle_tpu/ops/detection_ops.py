@@ -284,28 +284,71 @@ def _multiclass_nms(ctx, op):
 
 @register("detection_map")
 def _detection_map(ctx, op):
-    """mAP metric op (detection_map_op.cc) — simplified single-batch
-    11-point interpolated AP over the NMS output format above."""
+    """mAP metric op (detection_map_op.cc) — single-batch AP over the
+    NMS output format above. attrs: ap_version "11point" (interpolated)
+    or "integral" (recall-delta sum); evaluate_difficult=False with a
+    Difficult input excludes difficult ground truth VOC-style (difficult
+    GT leave the recall denominator, and detections matching ONLY
+    difficult GT are ignored — neither TP nor FP)."""
     det = ctx.in1(op, "DetectRes")          # [K, 6] (label, score, box)
     gt_label = ctx.in1(op, "Label")         # [G, 6] (label, x1,y1,x2,y2..)
     overlap_t = float(op.attr("overlap_threshold", 0.5))
+    ap_version = str(op.attr("ap_version", "11point") or "11point")
+    eval_difficult = bool(op.attr("evaluate_difficult", True))
     det_valid = det[:, 1] > 0
     gt_boxes = gt_label[:, -4:]
     gt_cls = gt_label[:, 0]
     iou = _iou_matrix(det[:, 2:6], gt_boxes)
     same_cls = det[:, 0:1] == gt_cls[None, :]
     matched = (iou > overlap_t) & same_cls
-    tp = jnp.any(matched, axis=1) & det_valid
+
+    if not eval_difficult and op.input("Difficult"):
+        difficult = ctx.in1(op, "Difficult").reshape(-1) > 0   # [G]
+    else:
+        difficult = jnp.zeros((gt_boxes.shape[0],), bool)
+    n_gt = jnp.maximum(jnp.sum(~difficult), 1)
+
+    # greedy one-to-one assignment in score order (VOC / the reference's
+    # per-GT visited flags): each GT matches AT MOST one detection, so a
+    # duplicate detection of an already-claimed GT is a false positive —
+    # without this, duplicates each count as TP and AP leaves [0, 1].
+    # Detections whose only matches are difficult GT are IGNORED
+    # (neither TP nor FP, the VOC difficult contract).
     order = jnp.argsort(-det[:, 1])
-    tp_sorted = tp[order]
+    matched_s = matched[order]
+    iou_s = iou[order]
+    valid_s = det_valid[order]
+    k = det.shape[0]
+
+    def body(i, carry):
+        used, tp, ign = carry
+        cand = matched_s[i] & ~used & ~difficult
+        hit = jnp.any(cand) & valid_s[i]
+        j = jnp.argmax(jnp.where(cand, iou_s[i], -1.0))
+        used = jnp.where(hit, used.at[j].set(True), used)
+        tp = tp.at[i].set(hit)
+        ign = ign.at[i].set(valid_s[i] & ~hit
+                            & jnp.any(matched_s[i] & difficult))
+        return used, tp, ign
+
+    used0 = jnp.zeros((gt_boxes.shape[0],), bool)
+    _, tp_sorted, ignored_s = lax.fori_loop(
+        0, k, body, (used0, jnp.zeros((k,), bool), jnp.zeros((k,), bool)))
+
+    counted = valid_s & ~ignored_s
     cum_tp = jnp.cumsum(tp_sorted)
-    total = jnp.arange(1, det.shape[0] + 1)
+    total = jnp.maximum(jnp.cumsum(counted), 1)
     precision = cum_tp / total
-    recall = cum_tp / jnp.maximum(gt_boxes.shape[0], 1)
-    ap = 0.0
-    for r in np.arange(0.0, 1.1, 0.1):
-        p = jnp.max(jnp.where(recall >= r, precision, 0.0))
-        ap = ap + p / 11.0
+    recall = cum_tp / n_gt
+    if ap_version == "integral":
+        # AP = sum of precision at each new true positive weighted by
+        # its recall increment (detection_map_op.h GetAccumulation path)
+        ap = jnp.sum(jnp.where(tp_sorted, precision, 0.0)) / n_gt
+    else:
+        ap = 0.0
+        for r in np.arange(0.0, 1.1, 0.1):
+            p = jnp.max(jnp.where(recall >= r, precision, 0.0))
+            ap = ap + p / 11.0
     ctx.set_out(op, "MAP", ap.reshape(1))
     ctx.set_out(op, "AccumPosCount", jnp.asarray([det.shape[0]]))
 

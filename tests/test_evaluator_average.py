@@ -24,15 +24,42 @@ def test_evaluator_aliases_are_metrics():
     assert fluid.evaluator.EditDistance is fluid.metrics.EditDistance
 
 
-def test_detection_map_rejects_unsupported_knobs():
+def test_detection_map_rejects_unknown_ap_version():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         det = fluid.layers.data("det", [6])
         gt = fluid.layers.data("gt", [5])
-        with pytest.raises(NotImplementedError, match="difficult"):
-            fluid.evaluator.DetectionMAP(det, gt, evaluate_difficult=False)
-        with pytest.raises(NotImplementedError, match="11point"):
-            fluid.evaluator.DetectionMAP(det, gt, ap_version="integral")
+        with pytest.raises(ValueError, match="ap_version"):
+            fluid.evaluator.DetectionMAP(det, gt, ap_version="7point")
+
+
+def test_detection_map_integral_and_difficult():
+    """Round-4 closures: integral AP and VOC-style difficult-GT
+    exclusion. One TP at rank 1 + one FP at rank 2 over 2 easy GT:
+    integral AP = (1/1)·(1/2) = 0.5; marking the missed GT difficult
+    makes the TP cover ALL easy GT -> AP 1.0."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        diff = fluid.layers.data("diff", [1])
+        m_int = fluid.evaluator.DetectionMAP(det, gt,
+                                             ap_version="integral")
+        m_nd = fluid.evaluator.DetectionMAP(det, gt, gt_difficult=diff,
+                                            evaluate_difficult=False,
+                                            ap_version="integral")
+        exe = fluid.Executor(fluid.CPUPlace())
+        dv = np.array([[0, 0.9, 0, 0, 10, 10],
+                       [0, 0.8, 50, 50, 60, 60]], np.float32)
+        gv = np.array([[0, 0, 0, 10, 10],
+                       [0, 20, 20, 30, 30]], np.float32)
+        difficult = np.array([[0.0], [1.0]], np.float32)
+        a, b = exe.run(main, feed={"det": dv, "gt": gv,
+                                   "diff": difficult},
+                       fetch_list=[m_int.metrics[0], m_nd.metrics[0]])
+        np.testing.assert_allclose(np.asarray(a), [0.5], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(b), [1.0], rtol=1e-5)
 
 
 def test_detection_map_evaluator():
@@ -57,3 +84,41 @@ def test_detection_map_evaluator():
     m.reset()
     with pytest.raises(ValueError):
         m.eval()
+
+
+def test_detection_map_duplicates_are_false_positives():
+    """One-to-one GT assignment (VOC visited flags): a duplicate
+    detection of an already-claimed GT is a false positive, so AP stays
+    in [0, 1] — two boxes on one GT give integral AP 1.0 (the TP covers
+    the single GT) with the duplicate only hurting precision, never
+    adding recall."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        m = fluid.layers.detection_map(det, gt, ap_version="integral")
+        exe = fluid.Executor(fluid.CPUPlace())
+        dv = np.array([[0, 0.9, 0, 0, 10, 10],
+                       [0, 0.8, 0, 0, 10, 10]], np.float32)
+        gv = np.array([[0, 0, 0, 10, 10]], np.float32)
+        mv, = exe.run(main, feed={"det": dv, "gt": gv}, fetch_list=[m])
+        np.testing.assert_allclose(np.asarray(mv), [1.0], rtol=1e-5)
+        # three GT, two dups on the first: integral AP = (1/1)/3 = 1/3
+        gv3 = np.array([[0, 0, 0, 10, 10], [0, 20, 20, 30, 30],
+                        [0, 40, 40, 50, 50]], np.float32)
+        mv3, = exe.run(main, feed={"det": dv, "gt": gv3},
+                       fetch_list=[m])
+        np.testing.assert_allclose(np.asarray(mv3), [1.0 / 3], rtol=1e-5)
+
+
+def test_layers_detection_map_validates_knobs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        with pytest.raises(ValueError, match="ap_version"):
+            fluid.layers.detection_map(det, gt, ap_version="7point")
+        with pytest.raises(ValueError, match="difficult"):
+            fluid.layers.detection_map(det, gt,
+                                       evaluate_difficult=False)
