@@ -283,10 +283,14 @@ def transformer_lm_parallel(vocab_size=4096, max_len=256, n_layer=4,
     """Flagship decoder-only LM wired to the parallel subsystem.
 
     strategy: parallel.DistributedStrategy (or None). The build adapts:
-      * pp > 1  → layers.pipelined_decoder_stack (GPipe over the pp axis)
+      * pp > 1  → layers.pipelined_decoder_stack (GPipe or interleaved
+                  virtual stages per strategy.pp_schedule); composes
+                  with tp (Megatron shards + psum inside the stage) and
+                  sp (ring attention inside the stage)
       * sp > 1  → attention via layers.sequence_parallel_attention
                   (ring attention over the sp axis)
-      * num_experts > 0 → FFN via layers.sparse_moe (ep axis)
+      * num_experts > 0 → FFN via layers.sparse_moe (ep axis; not
+                  composable with pp)
       * tp > 1  → Megatron-style sharding hints on attention/FFN weights
                   (col-shard in-proj, row-shard out-proj; GSPMD inserts
                   the allreduce)
