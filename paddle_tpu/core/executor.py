@@ -100,6 +100,12 @@ def _normalize_feeds(feed, accum_steps=1):
                         stacked[g, :totals[g]] = \
                             arr[offs[g * per]:offs[(g + 1) * per]]
                     feed_lods[k + "@LOD"] = lengths.reshape(k_acc, per)
+                    # true (pre-bucket) token totals per microbatch: the
+                    # loss-normalization weights for ragged accumulation
+                    # (runtime VALUES, not trace constants — same shape
+                    # every batch, so the compile cache stays stable)
+                    feed_lods[k + "@ACCUM_TOKENS"] = np.asarray(
+                        totals, np.float32)
                     static_info[k + "@ACCUM_LOD"] = True
                     arr = stacked
                 else:
@@ -554,7 +560,8 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _build(self, program, feed_names, fetch_names, state_keys,
-               static_info=None, check_nan=False, accum_steps=1):
+               static_info=None, check_nan=False, accum_steps=1,
+               accum_loss_norm=None):
         """Build the pure step function for one (program, signature).
 
         accum_steps > 1: GRADIENT ACCUMULATION — the feed batch is split
@@ -613,7 +620,8 @@ class Executor:
             if accum_steps > 1:
                 self._lower_with_grad_accum(ctx, ops, bwd_idx, block,
                                             feeds, accum_steps,
-                                            persistable_names)
+                                            persistable_names,
+                                            loss_norm=accum_loss_norm)
             elif bwd_idx is None:
                 for op in ops:
                     _lower_op(ctx, op)
@@ -754,7 +762,8 @@ class Executor:
 
     @staticmethod
     def _lower_with_grad_accum(ctx, ops, bwd_idx, block, feeds,
-                               accum_steps, persistable_names):
+                               accum_steps, persistable_names,
+                               loss_norm=None):
         """Gradient accumulation: lax.scan of fwd+bwd over microbatches.
 
         Feeds with batch dim > 1 split into accum_steps equal chunks
@@ -762,10 +771,24 @@ class Executor:
         scan carry holds (grad sums, loss sum, persistable state) so
         streaming forward-state updates (e.g. batch-norm counters) and
         NaN guards thread through microbatches; grads and the loss are
-        MEANS over microbatches — for a mean-reduced loss this equals the
-        full-batch gradient, so an optimizer step after accumulation
-        matches the unaccumulated step. Each microbatch gets its own RNG
-        stream (dropout masks differ per microbatch)."""
+        WEIGHTED sums over microbatches. The weights depend on how the
+        user's loss is normalized (``loss_norm``):
+
+        - ``"sequence"`` (and the dense equal-chunk case): w_i = 1/k.
+          Exact when the loss is a mean over per-sequence values — each
+          microbatch holds the same number of sequences.
+        - ``"token"`` / ``"token:<feed>"``: w_i = T_i / sum(T_j), the
+          true (pre-bucket) token totals of the ragged LoD pre-split
+          (``<feed>@ACCUM_TOKENS`` from _normalize_feeds). Exact when
+          the loss is a mean over TOKENS: full-batch token mean
+          = sum_i (T_i/T) * (per-microbatch token mean).
+
+        Ragged splits with UNEQUAL token totals and no explicit
+        loss_norm are rejected host-side (ParallelExecutor.run) — equal
+        weighting would silently mis-scale token-normalized losses.
+        With either exact weighting, an optimizer step after
+        accumulation matches the unaccumulated step. Each microbatch
+        gets its own RNG stream (dropout masks differ per microbatch)."""
         marker = ops[bwd_idx]
         wrt_names, target_names = Executor._parse_marker(marker)
         base_env = dict(ctx.env)
@@ -785,6 +808,8 @@ class Executor:
         chunked = {}
         for n in feeds:
             v = base_env[n]
+            if n.endswith("@ACCUM_TOKENS"):
+                continue          # weight inputs, consumed below
             if n in stacked:
                 chunked[n] = v                 # already [k, ...]
                 continue
@@ -800,6 +825,37 @@ class Executor:
         pstate0 = {n: v for n, v in base_env.items()
                    if n in persistable_names and n not in wrt}
         accum_key = ctx._rng_fn()    # base for per-microbatch streams
+
+        # Per-microbatch loss/grad weights (see docstring). Raggedness
+        # and multi-feed ambiguity are checked host-side on the concrete
+        # totals (parallel/executor.py); here the totals are tracers.
+        _TOK = "@ACCUM_TOKENS"
+        tok_arrays = {n[:-len(_TOK)]: base_env[n]
+                      for n in feeds if n.endswith(_TOK)}
+        norm = loss_norm or "sequence"
+        if norm.startswith("token") and not tok_arrays:
+            # the user asked for token weighting but no ragged LoD feed
+            # carries token counts — silently falling back to 1/k would
+            # be the exact mis-scaling this knob exists to prevent
+            raise ValueError(
+                "gradient_accumulation_loss_norm=%r: this program has no "
+                "ragged LoD feeds, so per-microbatch token counts are "
+                "unavailable; drop the knob (equal chunks weight equally) "
+                "or feed the sequence data as LoDTensor" % (loss_norm,))
+        if norm.startswith("token"):
+            if ":" in norm:
+                src = norm.split(":", 1)[1]
+                if src not in tok_arrays:
+                    raise ValueError(
+                        "gradient_accumulation_loss_norm=%r: %r is not "
+                        "a ragged LoD feed of this program (have %s)"
+                        % (loss_norm, src, sorted(tok_arrays)))
+                tok = tok_arrays[src]
+            else:
+                tok = next(iter(tok_arrays.values()))
+            weights = tok / jnp.sum(tok)
+        else:
+            weights = jnp.full((k,), 1.0 / k, jnp.float32)
 
         def forward(params, pstate, feeds_i, key_i):
             env = dict(base_env)
@@ -837,12 +893,13 @@ class Executor:
 
         def body(carry, xs):
             gsum, lsum, pstate, guards_ok = carry
-            feeds_i, idx = xs
+            feeds_i, idx, w_i = xs
             key_i = jax.random.fold_in(accum_key, idx)
             (loss, env_a), grads = jax.value_and_grad(
                 fwd, has_aux=True)(wrt, pstate, feeds_i, key_i)
-            gsum = jax.tree.map(jnp.add, gsum, grads)
-            lsum = lsum + loss
+            gsum = jax.tree.map(
+                lambda s, g: s + g * w_i.astype(g.dtype), gsum, grads)
+            lsum = lsum + loss * w_i.astype(loss.dtype)
             pstate = {n: env_a.get(n, pstate[n]) for n in pstate}
             guards_ok = {g: guards_ok[g]
                          & env_a.get(g, jnp.asarray(True))
@@ -871,7 +928,7 @@ class Executor:
                 pstate0,
                 {g: jnp.asarray(True) for g in guard_names})
         (gsum, lsum, pstate, guards_ok), _ = jax.lax.scan(
-            body, init, (chunked, jnp.arange(k)))
+            body, init, (chunked, jnp.arange(k), weights))
 
         ctx.env.update(pstate)
         ctx.env.update(guards_ok)
@@ -910,13 +967,13 @@ class Executor:
             if n in probe_env:
                 ctx.env[n] = probe_env[n]
 
-        ctx.env[loss_name] = lsum / k
+        ctx.env[loss_name] = lsum     # weights sum to 1: already a mean
         fwd_guard_idx = [int(g[len(_NANGUARD):].split("|", 1)[0])
                          for g in guard_names]
         ctx._nan_idx = max(fwd_guard_idx, default=-1) + 1
         ctx.env[loss_name + "@GRAD"] = jnp.ones_like(lsum)
         for p in wrt:
-            ctx.env[p + "@GRAD"] = gsum[p] / k
+            ctx.env[p + "@GRAD"] = gsum[p]
         for op in ops[bwd_idx + 1:]:
             _lower_op(ctx, op)
 

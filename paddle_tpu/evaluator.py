@@ -29,12 +29,19 @@ class DetectionMAP:
 
         # both AP versions are implemented ("11point" interpolated and
         # "integral" recall-delta); evaluate_difficult=False excludes
-        # difficult ground truth VOC-style via the gt_difficult column
-        # (class_num is accepted — classes come from the label column)
+        # difficult ground truth VOC-style via the gt_difficult column;
+        # class_num > 0 gives true per-class-averaged mAP (else AP is
+        # class-pooled — see ops/detection_ops.py _detection_map)
         if ap_version not in ("11point", "integral"):
             raise ValueError(
                 "DetectionMAP: ap_version must be '11point' or "
                 "'integral', got %r" % (ap_version,))
+        if not evaluate_difficult and gt_difficult is None:
+            # same contract as layers.detection_map: excluding difficult
+            # GT without the difficult flags would silently count them
+            raise ValueError(
+                "DetectionMAP: evaluate_difficult=False needs the "
+                "gt_difficult ground-truth flag input")
 
         helper = LayerHelper("detection_map_eval")
         label = gt_label if gt_box is None else \
@@ -50,6 +57,7 @@ class DetectionMAP:
             outputs={"MAP": [m], "AccumPosCount": [acc]},
             attrs={"overlap_threshold": overlap_threshold,
                    "ap_version": ap_version,
+                   "class_num": int(class_num or 0),
                    "background_label": background_label,
                    "evaluate_difficult": evaluate_difficult})
         self.metrics = [m]

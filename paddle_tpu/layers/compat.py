@@ -346,6 +346,7 @@ def detection_map(detect_res, label, class_num=None, background_label=0,
                    {"overlap_threshold": overlap_threshold,
                     "ap_version": ap_version,
                     "evaluate_difficult": bool(evaluate_difficult),
+                    "class_num": int(class_num or 0),
                     "background_label": background_label},
                    out_slots=("MAP", "AccumPosCount"))
     return m

@@ -336,19 +336,51 @@ def _detection_map(ctx, op):
         0, k, body, (used0, jnp.zeros((k,), bool), jnp.zeros((k,), bool)))
 
     counted = valid_s & ~ignored_s
-    cum_tp = jnp.cumsum(tp_sorted)
-    total = jnp.maximum(jnp.cumsum(counted), 1)
-    precision = cum_tp / total
-    recall = cum_tp / n_gt
-    if ap_version == "integral":
-        # AP = sum of precision at each new true positive weighted by
-        # its recall increment (detection_map_op.h GetAccumulation path)
-        ap = jnp.sum(jnp.where(tp_sorted, precision, 0.0)) / n_gt
-    else:
+    cls_sorted = det[order, 0]
+
+    def _ap_over(mask, n_gt_cls):
+        """AP restricted to detections where `mask` (score-sorted
+        positions); precision/recall walk only that class's detections
+        (detection_map_op.h computes per-class true/false-positive
+        vectors)."""
+        tp_m = tp_sorted & mask
+        cum_tp = jnp.cumsum(tp_m)
+        total = jnp.maximum(jnp.cumsum(counted & mask), 1)
+        denom = jnp.maximum(n_gt_cls, 1)
+        precision = cum_tp / total
+        recall = cum_tp / denom
+        if ap_version == "integral":
+            # AP = sum of precision at each new true positive weighted
+            # by its recall increment (GetAccumulation path)
+            return jnp.sum(jnp.where(tp_m, precision, 0.0)) / denom
         ap = 0.0
         for r in np.arange(0.0, 1.1, 0.1):
-            p = jnp.max(jnp.where(recall >= r, precision, 0.0))
+            p = jnp.max(jnp.where((recall >= r) & mask, precision, 0.0))
             ap = ap + p / 11.0
+        return ap
+
+    class_num = int(op.attr("class_num", 0) or 0)
+    if class_num > 0:
+        # true mAP (detection_map_op.h): per-class AP, averaged over the
+        # classes that have (non-difficult) ground truth. vmapped over
+        # the class axis so the trace stays one AP pipeline regardless
+        # of class count.
+        background = int(op.attr("background_label", 0))
+        cls_ids = jnp.asarray([c for c in range(class_num)
+                               if c != background], jnp.float32)
+        masks = cls_sorted[None, :] == cls_ids[:, None]        # [C', K]
+        gt_counts = jnp.sum(
+            (gt_cls[None, :] == cls_ids[:, None]) & ~difficult[None, :],
+            axis=1)                                            # [C']
+        ap_c = jax.vmap(_ap_over)(masks, gt_counts)
+        has = (gt_counts > 0).astype(jnp.float32)
+        ap = jnp.sum(ap_c * has) / jnp.maximum(jnp.sum(has), 1.0)
+    else:
+        # class_num unknown: CLASS-POOLED AP — one ranked list across
+        # classes (matching stays class-aware). This deviates from the
+        # reference's per-class average when several classes are
+        # present; pass class_num for true mAP.
+        ap = _ap_over(jnp.ones_like(counted), n_gt)
     ctx.set_out(op, "MAP", ap.reshape(1))
     ctx.set_out(op, "AccumPosCount", jnp.asarray([det.shape[0]]))
 

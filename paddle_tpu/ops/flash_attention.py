@@ -392,12 +392,20 @@ def _resolve_path(q, scale, block_q, block_k, force):
     keeps the fused kernel instead of demoting to dense."""
     scale = float(scale) if scale else q.shape[-1] ** -0.5
     t = q.shape[2]
-    block_q = block_q or _largest_divisor(t, _AUTO_BLOCK)
-    block_k = block_k or _largest_divisor(t, _AUTO_BLOCK)
+    auto_degenerate = False
+    if not block_q or not block_k:
+        auto = _largest_divisor(t, _AUTO_BLOCK)
+        # a T with no divisor >= 128 below the cap (prime, 2*prime, ...)
+        # would yield a near-T^2 grid of tiny blocks — far worse than
+        # dense XLA; demote instead of silently compiling a cliff
+        auto_degenerate = auto < min(128, t)
+        block_q = block_q or auto
+        block_k = block_k or auto
     path = force
     if path is None:
         usable = (t % min(block_q, t) == 0 and t % min(block_k, t) == 0
-                  and t >= 128 and q.shape[-1] % 8 == 0)
+                  and t >= 128 and q.shape[-1] % 8 == 0
+                  and not auto_degenerate)
         path = "pallas" if (usable and _on_tpu(q)) else "dense"
     return path, scale, min(block_q, t), min(block_k, t)
 

@@ -74,6 +74,7 @@ class DistributedStrategy:
 
     def __init__(self, dp=None, tp=1, pp=1, sp=1, ep=1,
                  use_bf16_compute=False, gradient_accumulation_steps=1,
+                 gradient_accumulation_loss_norm=None,
                  pp_schedule="gpipe", pp_virtual_stages=0):
         self.dp = dp
         self.tp = tp
@@ -82,6 +83,10 @@ class DistributedStrategy:
         self.ep = ep
         self.use_bf16_compute = use_bf16_compute
         self.gradient_accumulation_steps = gradient_accumulation_steps
+        # loss-normalization contract for ragged (LoD) accumulation:
+        # None | "sequence" | "token" | "token:<feed_name>" — see
+        # ParallelExecutor._check_accum_weights
+        self.gradient_accumulation_loss_norm = gradient_accumulation_loss_norm
         # pipeline schedule: "gpipe" (M >= S) or "interleaved" (Megatron
         # virtual stages, bubble / pp_virtual_stages; M <= S regime)
         self.pp_schedule = pp_schedule

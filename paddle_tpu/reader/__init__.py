@@ -227,6 +227,19 @@ def open_files(filenames, thread_num=1, buffer_size=64, shard_id=None,
                     if num_shards is None else num_shards
         except Exception:
             pass
+    if (shard_id is None) != (num_shards is None):
+        # half a sharding spec on a single-process host would silently
+        # read ALL files — in a multi-host launch that DUPLICATES the
+        # data instead of sharding it
+        raise ValueError(
+            "open_files: got %s without %s — pass both shard_id and "
+            "num_shards (or neither, to default from the jax process "
+            "layout)" % (("shard_id", "num_shards") if num_shards is None
+                         else ("num_shards", "shard_id")))
+    if shard_id is not None and not 0 <= int(shard_id) < int(num_shards):
+        raise ValueError(
+            "open_files: shard_id %s out of range for num_shards %s"
+            % (shard_id, num_shards))
     if num_shards and num_shards > 1:
         mine = filenames[int(shard_id or 0)::int(num_shards)]
         if not mine:

@@ -122,3 +122,42 @@ def test_layers_detection_map_validates_knobs():
         with pytest.raises(ValueError, match="difficult"):
             fluid.layers.detection_map(det, gt,
                                        evaluate_difficult=False)
+
+
+def test_detection_map_per_class_average():
+    """class_num > 0 -> true mAP (detection_map_op.h): per-class AP
+    averaged over classes with GT. Crafted so pooled != per-class:
+    class 2's lone TP ranks above class 1's FP+TP.
+      per-class integral: AP(c2)=1, AP(c1)=1/2 -> mAP 0.75
+      pooled ranked list: (1/1 + 2/3)/2 = 0.8333
+    """
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        m_cls = fluid.layers.detection_map(det, gt, class_num=3,
+                                           ap_version="integral")
+        m_pool = fluid.layers.detection_map(det, gt,
+                                            ap_version="integral")
+        exe = fluid.Executor(fluid.CPUPlace())
+        dv = np.array([[2, 0.9, 20, 20, 30, 30],     # TP class 2
+                       [1, 0.8, 50, 50, 60, 60],     # FP class 1
+                       [1, 0.7, 0, 0, 10, 10]],      # TP class 1
+                      np.float32)
+        gv = np.array([[1, 0, 0, 10, 10],
+                       [2, 20, 20, 30, 30]], np.float32)
+        a, b = exe.run(main, feed={"det": dv, "gt": gv},
+                       fetch_list=[m_cls, m_pool])
+        np.testing.assert_allclose(np.asarray(a), [0.75], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(b), [5.0 / 6], rtol=1e-5)
+
+
+def test_detection_map_evaluator_requires_difficult_input():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        with pytest.raises(ValueError, match="difficult"):
+            fluid.evaluator.DetectionMAP(det, gt,
+                                         evaluate_difficult=False)

@@ -208,3 +208,20 @@ def test_bwd_vmem_clamp_keeps_divisibility():
         scale = float(jnp.max(jnp.abs(a))) + 1e-9
         err = float(jnp.max(jnp.abs(a - b))) / scale
         assert err < 5e-3, (name, err)
+
+
+def test_auto_block_degenerate_t_demotes_to_dense(monkeypatch):
+    """T with no divisor >= 128 under the auto cap (prime 4099, 2*1031)
+    must NOT build a near-T^2 grid of tiny blocks — auto sizing demotes
+    to the dense path; explicit block sizes still honor the caller."""
+    monkeypatch.setattr(FA, "_on_tpu", lambda x: True)
+
+    def path_for(t, block=None):
+        q = jnp.zeros((1, 1, t, 64), jnp.float32)
+        return FA._resolve_path(q, None, block, block, None)[0]
+
+    assert path_for(2048) == "pallas"        # sanity: clean T stays fused
+    assert path_for(4099) == "dense"         # prime
+    assert path_for(2 * 1031) == "dense"     # largest divisor 2
+    assert path_for(17 * 127) == "dense"     # largest divisor 127 < 128
+    assert path_for(2062, block=1031) == "pallas"  # explicit block wins

@@ -236,3 +236,78 @@ def test_lod_accumulation_rejects_indivisible_sequences():
     t = _lod(np.random.rand(7, 2).astype(np.float32), [3, 2, 2])
     with pytest.raises(ValueError, match="not divisible"):
         _normalize_feeds({"x": t}, accum_steps=2)
+
+
+def _train_token_norm(accum_steps, loss_norm=None, steps=3):
+    """TOKEN-normalized loss (mean over tokens, not sequences) under a
+    ragged split whose microbatch token totals are UNEQUAL. Equal
+    microbatch weighting is wrong here; loss_norm='token' weights each
+    microbatch by its true token count, which reproduces the full-batch
+    token mean exactly."""
+    from paddle_tpu.core import unique_name
+    rng = np.random.RandomState(5)
+    lengths = [1, 2, 3, 2, 5, 3, 7, 1]   # k=2 -> totals [8, 16]: unequal
+    total = sum(lengths)
+    xv = rng.rand(total, 4).astype(np.float32)
+    wv = np.asarray(lengths, np.float32).reshape(-1, 1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard("tn_"):
+        x = fluid.layers.data("x", [4], lod_level=1)
+        w = fluid.layers.data("w", [1])      # per-sequence token counts
+        h = fluid.layers.fc(x, 8, act="tanh")
+        per_seq = fluid.layers.sequence_pool(h, "sum")   # sum over tokens
+        tok_sum = fluid.layers.reduce_sum(per_seq)
+        n_tok = fluid.layers.reduce_sum(w)
+        loss = fluid.layers.elementwise_div(tok_sum, n_tok)  # token mean
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        strategy = parallel.DistributedStrategy(
+            gradient_accumulation_steps=accum_steps,
+            gradient_accumulation_loss_norm=loss_norm)
+        pexe = fluid.ParallelExecutor(loss_name=loss.name,
+                                      main_program=main, scope=scope,
+                                      strategy=strategy)
+        losses = []
+        for _ in range(steps):
+            # w chunks with the batch dim, so microbatch i sees its own
+            # sequences' lengths: loss_i = S_i / T_i, and the 'token'
+            # weights T_i/T recover the full-batch S/T exactly
+            losses.append(float(np.asarray(pexe.run(
+                [loss], feed={"x": _lod(xv, lengths), "w": wv})[0])))
+        params = {v.name: np.asarray(scope.find_var(v.name)).copy()
+                  for v in main.global_block().vars.values()
+                  if v.persistable and scope.find_var(v.name) is not None}
+    return losses, params
+
+
+def test_token_normalized_accumulation_matches_full_batch():
+    losses1, params1 = _train_token_norm(accum_steps=1)
+    losses2, params2 = _train_token_norm(accum_steps=2, loss_norm="token")
+    np.testing.assert_allclose(losses2, losses1, rtol=2e-5, atol=1e-6)
+    for n in params1:
+        np.testing.assert_allclose(params2[n], params1[n], rtol=1e-4,
+                                   atol=1e-5, err_msg=n)
+
+
+def test_token_normalized_accumulation_sequence_weighting_differs():
+    # sharpness check: equal ('sequence') weighting is NOT exact for a
+    # token-normalized loss over an unequal split — if this ever starts
+    # passing, the token test above lost its teeth
+    losses1, _ = _train_token_norm(accum_steps=1)
+    losses_seq, _ = _train_token_norm(accum_steps=2, loss_norm="sequence")
+    assert abs(losses_seq[0] - losses1[0]) > 1e-4
+
+
+def test_ragged_unequal_totals_require_explicit_loss_norm():
+    with pytest.raises(ValueError, match="unequal"):
+        _train_token_norm(accum_steps=2, loss_norm=None)
+
+
+def test_accum_loss_norm_validated():
+    with pytest.raises(ValueError, match="loss_norm"):
+        _train_token_norm(accum_steps=2, loss_norm="bogus")

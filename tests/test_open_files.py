@@ -193,3 +193,13 @@ def test_open_files_multihost_disjoint_shards(tmp_path):
     assert not (set(shards[0]) & set(shards[1]))
     assert sorted(shards[0] + shards[1]) == sorted(
         f * 100 + i for f in range(4) for i in range(5))
+
+
+def test_open_files_half_shard_spec_raises(tmp_path):
+    paths = _write_files(tmp_path, n_files=2)
+    with pytest.raises(ValueError, match="num_shards"):
+        open_files(paths, shard_id=0)
+    with pytest.raises(ValueError, match="shard_id"):
+        open_files(paths, num_shards=2)
+    with pytest.raises(ValueError, match="out of range"):
+        open_files(paths, shard_id=2, num_shards=2)
