@@ -70,6 +70,13 @@ _register("profile_memory", bool, False,
 _register("data_home", str,
           os.path.expanduser("~/.cache/paddle_tpu/dataset"),
           "dataset cache directory")
+_register("gather_sharded_fetches", bool, False,
+          "fetch-time all-gather of cross-process SHARDED values: every "
+          "process receives the merged global array (the reference "
+          "ParallelExecutor merged fetched tensors across devices, "
+          "parallel_executor.cc:190-197). Default OFF: the gather "
+          "crosses DCN on every fetch, so the default stays the loud "
+          "NotImplementedError telling you to fetch replicated values")
 _register("fuse_conv_bn", bool, False,
           "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
           "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "

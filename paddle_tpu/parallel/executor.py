@@ -66,6 +66,20 @@ class ParallelExecutor:
         # the model builders; these two belong to the executor)
         self._accum_steps = max(
             1, int(getattr(strategy, "gradient_accumulation_steps", 1)))
+        # How the loss is normalized, for ragged-LoD accumulation
+        # weighting: None (reject ragged-unequal splits), "sequence",
+        # "token", or "token:<feed_name>" — see
+        # Executor._lower_with_grad_accum.
+        self._accum_loss_norm = getattr(
+            strategy, "gradient_accumulation_loss_norm", None)
+        if self._accum_loss_norm is not None and not (
+                self._accum_loss_norm == "sequence"
+                or self._accum_loss_norm == "token"
+                or self._accum_loss_norm.startswith("token:")):
+            raise ValueError(
+                "gradient_accumulation_loss_norm must be 'sequence', "
+                "'token', or 'token:<feed_name>'; got %r"
+                % (self._accum_loss_norm,))
         # use_bf16_compute=True pins AMP on for THIS executor's traces
         # (restored after each build — the global flag is not leaked);
         # False (the default) leaves the ambient AMP setting alone
@@ -85,6 +99,43 @@ class ParallelExecutor:
         spec = self._program._sharding_hints.get(name)
         return spec_to_named_sharding(self.mesh, spec)
 
+    def _check_accum_weights(self, feed_arrays):
+        """Host-side guard for ragged gradient accumulation (concrete
+        per-microbatch token totals from _normalize_feeds).
+
+        Equal-weight averaging of microbatch losses is only exact when
+        every microbatch carries equal weight in the full-batch loss;
+        with unequal token totals that holds for per-sequence-mean
+        losses but silently mis-scales token-normalized ones. So:
+        unequal totals require an explicit loss_norm, and 'token' with
+        several disagreeing LoD feeds requires naming the one that
+        normalizes the loss."""
+        _TOK = "@ACCUM_TOKENS"
+        toks = {n[:-len(_TOK)]: np.asarray(v)
+                for n, v in feed_arrays.items() if n.endswith(_TOK)}
+        norm = self._accum_loss_norm
+        if norm is None:
+            ragged = sorted(n for n, t in toks.items()
+                            if not np.all(t == t[0]))
+            if ragged:
+                raise ValueError(
+                    "gradient accumulation with ragged LoD feeds: "
+                    "microbatch token totals are unequal for %s. Equal "
+                    "microbatch weighting is only exact for per-"
+                    "sequence-mean losses. Set DistributedStrategy."
+                    "gradient_accumulation_loss_norm='sequence' (loss "
+                    "is a mean over sequences) or 'token' (loss is a "
+                    "mean over tokens; microbatches are weighted by "
+                    "their true token counts)." % ragged)
+        elif norm == "token" and len(toks) > 1:
+            rep = {tuple(t.tolist()) for t in toks.values()}
+            if len(rep) > 1:
+                raise ValueError(
+                    "gradient_accumulation_loss_norm='token' is "
+                    "ambiguous: LoD feeds %s have different microbatch "
+                    "token totals. Name the feed the loss normalizes "
+                    "over: 'token:<feed_name>'." % sorted(toks))
+
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = dict(feed or feed_dict or {})
         program = self._program
@@ -102,7 +153,10 @@ class ParallelExecutor:
         from ..core.executor import _normalize_feeds
         feed_arrays, static_info = _normalize_feeds(
             feed, accum_steps=self._accum_steps)
-        lod_keys = {k for k in feed_arrays if k.endswith("@LOD")}
+        if self._accum_steps > 1:
+            self._check_accum_weights(feed_arrays)
+        lod_keys = {k for k in feed_arrays
+                    if k.endswith("@LOD") or k.endswith("@ACCUM_TOKENS")}
         lod_keys |= {k for k, v in feed.items() if isinstance(v, LoDTensor)}
         for k, v in feed_arrays.items():
             if k in lod_keys:
@@ -129,7 +183,8 @@ class ParallelExecutor:
         from ..flags import get_flag
         key = (program, program._version, _feed_signature(feed_arrays),
                fetch_names, state_keys, hints, check_nan, use_amp,
-               self._accum_steps, get_flag("fuse_conv_bn"),
+               self._accum_steps, self._accum_loss_norm,
+               get_flag("fuse_conv_bn"),
                tuple(sorted(static_info.items())))
         entry = self._cache.get(key)
         repl = NamedSharding(self.mesh, PartitionSpec())
@@ -138,7 +193,8 @@ class ParallelExecutor:
                                      fetch_names, state_keys,
                                      static_info=static_info,
                                      check_nan=check_nan,
-                                     accum_steps=self._accum_steps)
+                                     accum_steps=self._accum_steps,
+                                     accum_loss_norm=self._accum_loss_norm)
 
             def fn(state, feeds, key, _fn=built, _amp=use_amp):
                 # lowering reads the AMP flag at TRACE time; pin it for
@@ -204,16 +260,25 @@ class ParallelExecutor:
         def local_value(v):
             # a replicated output's sharding spans remote devices; its
             # local shard IS the value. A dp-SHARDED fetch has no local
-            # full value — fail loudly rather than hand back 1/N of the
-            # batch (fetch losses/metrics, which the step all-reduces).
+            # full value: with FLAGS gather_sharded_fetches on, all-gather
+            # it so every process fetches the merged global array (the
+            # reference merged fetched tensors across devices,
+            # parallel_executor.cc:190-197); default stays the loud
+            # refusal rather than handing back 1/N of the batch.
             if multiproc and isinstance(v, jax.Array) \
                     and not v.is_fully_addressable:
                 if not v.sharding.is_fully_replicated:
+                    if get_flag("gather_sharded_fetches"):
+                        from jax.experimental import multihost_utils
+                        return np.asarray(
+                            multihost_utils.process_allgather(
+                                v, tiled=True))
                     raise NotImplementedError(
                         "fetching a cross-process SHARDED value (spec %s) "
                         "is not supported — fetch replicated values "
-                        "(losses/metrics) or gather in-graph first"
-                        % (v.sharding.spec,))
+                        "(losses/metrics), gather in-graph first, or set "
+                        "PADDLE_TPU_GATHER_SHARDED_FETCHES=1 to all-"
+                        "gather at fetch time" % (v.sharding.spec,))
                 return np.asarray(list(v.addressable_shards)[0].data)
             return v
 

@@ -4,20 +4,32 @@ the driver's dryrun_multichip uses the same mechanism)."""
 
 import os
 
-# override, don't setdefault: the driver environment pre-sets
-# JAX_PLATFORMS=axon (the one real TPU chip), and the axon plugin re-prepends
-# itself to jax_platforms even over an env override — so force the config
-# AFTER import too. The suite must run on the virtual 8-device CPU platform
-# per the multi-chip test strategy.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# The TPU-place sweep (tests_tpu/run_sweep.py; SURVEY §4.1 "TPUPlace added
+# to the place list") runs SELECTED single-chip op-level files against the
+# real accelerator: in that mode the platform is left alone (axon) and
+# fluid.CPUPlace is aliased to the accelerator place so hardcoded
+# Executor(fluid.CPUPlace()) tests execute on the chip.
+_TPU_SWEEP = os.environ.get("PADDLE_TPU_OPTEST_PLACE", "").lower() == "tpu"
+
+if not _TPU_SWEEP:
+    # override, don't setdefault: the driver environment pre-sets
+    # JAX_PLATFORMS=axon (the one real TPU chip), and the axon plugin
+    # re-prepends itself to jax_platforms even over an env override — so
+    # force the config AFTER import too. The suite must run on the virtual
+    # 8-device CPU platform per the multi-chip test strategy.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_SWEEP:
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import paddle_tpu as _fluid
+    _fluid.CPUPlace = _fluid.TPUPlace
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

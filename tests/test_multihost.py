@@ -133,3 +133,77 @@ def test_two_process_tensor_parallel(tmp_path):
     # device_i of rank 1): the Megatron allreduce genuinely crosses the
     # process boundary. ({"dp":4,"tp":2} would give intra-process pairs.)
     _run_pair(tmp_path, '{"tp": 2, "dp": 4}')
+
+
+_FETCH_WORKER = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import parallel
+from paddle_tpu.distributed import launch
+
+launch.init_parallel_env()
+rank = launch.trainer_id()
+mesh = launch.global_mesh({"dp": 8})
+
+x = fluid.layers.data("x", [4])
+pred = fluid.layers.fc(x, 3, bias_attr=False,
+                       param_attr=fluid.ParamAttr(
+                           name="w",
+                           initializer=fluid.initializer.Constant(0.5)))
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+pexe = fluid.ParallelExecutor(loss_name=None, mesh=mesh)
+xv = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+
+# default: dp-sharded activation fetch refuses loudly
+try:
+    pexe.run([pred], feed={"x": xv})
+    print("RESULTF rank=%%d refused=0 ok=0" %% rank, flush=True)
+    sys.exit(0)
+except NotImplementedError as e:
+    assert "GATHER_SHARDED_FETCHES" in str(e), e
+
+# flag on: fetch-time all-gather -> every process sees the FULL batch
+fluid.flags.set_flag("gather_sharded_fetches", True)
+v, = pexe.run([pred], feed={"x": xv})
+got = np.asarray(v)
+want = xv @ np.full((4, 3), 0.5, np.float32)
+ok = int(got.shape == (16, 3) and np.allclose(got, want, rtol=1e-5))
+print("RESULTF rank=%%d refused=1 ok=%%d" %% (rank, ok), flush=True)
+"""
+
+
+def test_two_process_sharded_fetch_gather(tmp_path):
+    """parallel_executor.cc:190-197 parity: with gather_sharded_fetches
+    on, a dp-sharded activation fetch all-gathers so each process gets
+    the merged global batch; default stays the loud refusal."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker_fetch.py"
+    script.write_text(_FETCH_WORKER % {"repo": repo})
+    port = _free_port()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_COORDINATOR": "127.0.0.1:%d" % port,
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ID": str(r),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, out[-3000:]
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("RESULTF")][0]
+        assert "refused=1" in line and "ok=1" in line, line
