@@ -319,9 +319,13 @@ def _extract_patches(x, ksize, strides, paddings):
         lowest = int(jnp.iinfo(x.dtype).min)
     xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
                  constant_values=lowest)
+    # HIGHEST precision: patch extraction is pure data movement (a
+    # one-hot conv); the TPU's default bf16 MXU pass would QUANTIZE the
+    # copied values, corrupting pooled maxima
     patches = lax.conv_general_dilated_patches(
         xp, filter_shape=ksize, window_strides=strides,
-        padding=[(0, 0), (0, 0)], dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        padding=[(0, 0), (0, 0)], dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.HIGHEST)
     # patches: [N, C*kh*kw, Ho, Wo]
     ho, wo = patches.shape[2], patches.shape[3]
     patches = patches.reshape(n, c, kh * kw, ho, wo)

@@ -208,8 +208,11 @@ def _im2sequence(ctx, op):
     n, c, h, w = x.shape
     oh = (h - kh) // sh + 1
     ow = (w - kw) // sw + 1
+    # HIGHEST precision: pure data movement (a one-hot conv) — the TPU
+    # default bf16 MXU pass would quantize the copied pixel values
     patches = jax.lax.conv_general_dilated_patches(
         x, (kh, kw), (sh, sw), "VALID",
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [N, C*kh*kw, oh, ow]
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=jax.lax.Precision.HIGHEST)          # [N, C*kh*kw, oh, ow]
     seq = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
     ctx.set_out(op, "Out", seq)

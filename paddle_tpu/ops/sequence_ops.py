@@ -13,6 +13,7 @@ outputs via ``@LOD`` entries in the env so chained sequence ops keep working.
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..core.registry import register
 
@@ -57,11 +58,10 @@ def _sequence_pool(ctx, op):
         s = jax.ops.segment_sum(x, seg, num_segments=n)
         out = s / jnp.sqrt(jnp.maximum(lengths, 1).astype(x.dtype))[:, None]
     elif ptype == "MAX":
-        out = jax.ops.segment_max(x, seg, num_segments=n)
-        maxidx = _segment_argmax(x, seg, n)
+        out, maxidx = _argext_pool(x, seg, n, lengths, is_max=True)
         ctx.set_out(op, "MaxIndex", maxidx)
     elif ptype == "MIN":
-        out = jax.ops.segment_min(x, seg, num_segments=n)
+        out, _ = _argext_pool(x, seg, n, lengths, is_max=False)
     elif ptype == "LAST":
         idx = jnp.cumsum(lengths) - 1
         out = x[idx]
@@ -72,18 +72,46 @@ def _sequence_pool(ctx, op):
     ctx.set_out(op, "Out", out)
 
 
-def _segment_argmax(x, seg, n):
+def _segment_argmax(x, seg, n, is_max=True):
     t = x.shape[0]
     idx = jnp.arange(t)
-    # for each segment and feature, the position of the max
+    # for each segment and feature, the position of the max (or min)
     def one_feature(col):
-        best = jax.ops.segment_max(col, seg, num_segments=n)
-        is_max = col == best[seg]
-        pos = jnp.where(is_max, idx, t)
+        best = (jax.ops.segment_max if is_max
+                else jax.ops.segment_min)(col, seg, num_segments=n)
+        is_best = col == best[seg]
+        pos = jnp.where(is_best, idx, t)
         return jax.ops.segment_min(pos, seg, num_segments=n)
     if x.ndim == 1:
         return one_feature(x)
     return jax.vmap(one_feature, in_axes=1, out_axes=1)(x).astype(jnp.int32)
+
+
+def _argext_pool(x, seg, n, lengths, is_max):
+    """MAX/MIN pooling through the explicit arg-extremum GATHER, not
+    segment_max/min autodiff: those route cotangents by an
+    x == extremum[seg] equality test, and when XLA rematerializes the
+    producer (e.g. an upstream lstm scan) in the backward pass with
+    different fusion, the recomputed values compare unequal on TPU —
+    gradients silently mis-route (measured 15x off on the real chip).
+    The gather's transpose scatter-adds to the stored winner row: exact
+    one-winner semantics, the reference's MaxIndex contract
+    (sequence_pool_op.h MaxSeqPoolGradFunctor). Empty segments keep the
+    segment-op identity value and leak NO gradient (the jnp.where
+    selects a constant there, cutting the gather's grad path)."""
+    argidx = _segment_argmax(x, seg, n, is_max=is_max)
+    safe = jnp.clip(lax.stop_gradient(argidx), 0, x.shape[0] - 1)
+    gathered = jnp.take_along_axis(x, safe, axis=0) if x.ndim > 1 \
+        else x[safe]
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        ident = jnp.finfo(x.dtype).min if is_max else jnp.finfo(x.dtype).max
+    else:
+        ident = jnp.iinfo(x.dtype).min if is_max else jnp.iinfo(x.dtype).max
+    empty = lengths <= 0
+    if x.ndim > 1:
+        empty = empty[:, None]
+    out = jnp.where(empty, jnp.asarray(ident, x.dtype), gathered)
+    return out, argidx
 
 
 @register("sequence_first_step")

@@ -910,6 +910,11 @@ def test_registry_completeness():
 
 
 def test_print_op_smoke():
+    from op_test import on_tpu_place
+    if on_tpu_place():
+        # axon PJRT transport has no host send/recv callbacks, which
+        # jax.debug.print needs (EXEMPT_TPU in tests_tpu/run_sweep.py)
+        pytest.skip("axon transport lacks host callbacks")
     x = _r(2, 2, seed=178)
     got = run_op("print", {"In": x}, {"message": "sweep"}, ["Out"])
     np.testing.assert_allclose(np.asarray(got["Out"]), x)

