@@ -83,7 +83,9 @@ def sparse_moe(x, num_experts, d_inner, capacity_factor=1.25,
 def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
                             num_microbatches=0, recompute=False,
                             schedule="gpipe", virtual_stages=0,
-                            tp_shard=False, name=None):
+                            tp_shard=False, num_experts=0, moe_top_k=1,
+                            moe_capacity_factor=1.25, moe_gate_groups=1,
+                            name=None):
     """L identical causal decoder layers with layer-stacked parameters
     ([L, ...], leading dim sharded on the pp mesh axis → pipeline
     schedule under ParallelExecutor; lax.scan over layers otherwise).
@@ -94,6 +96,16 @@ def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
     requires M <= S). tp_shard=True adds Megatron col/row sharding
     hints for a tp mesh axis (the pp x tp composition — the stage body
     then psums per sublayer; ops/parallel_ops._decoder_layer_apply_tp).
+
+    num_experts > 0 replaces every layer's dense FFN with a routed MoE
+    layer (experts' hidden dim = d_inner) — the pp x ep composition:
+    expert stacks shard on the ep mesh axis and the dispatch
+    all-to-alls inside the stage body. Requires an explicit
+    num_microbatches and moe_gate_groups = dp*ep of the target mesh
+    (routing is per-microbatch per token-group; the static attrs let
+    the dense fallback reproduce it exactly), and the call then
+    returns (out, aux_loss) instead of out.
+
     x: [B, T, D]. Returns [B, T, D]."""
     helper = LayerHelper("pipeline_stack", name=name)
     d = int(x.shape[-1])
@@ -124,19 +136,55 @@ def pipelined_decoder_stack(x, n_layer, n_head, d_inner,
         "WO": p(".wo", (L, d, d), Normal(0., std)),
         "LN1S": p(".ln1_s", (L, d), Constant(1.0)),
         "LN1B": p(".ln1_b", (L, d), Constant(0.0)),
-        "W1": p(".w1", (L, d, d_inner), Normal(0., std)),
-        "B1": p(".b1", (L, d_inner), Constant(0.0)),
-        "W2": p(".w2", (L, d_inner, d), Normal(0., d_inner ** -0.5)),
-        "B2": p(".b2", (L, d), Constant(0.0)),
         "LN2S": p(".ln2_s", (L, d), Constant(1.0)),
         "LN2B": p(".ln2_b", (L, d), Constant(0.0)),
     }
+    moe = int(num_experts) > 0
+    if moe:
+        e = int(num_experts)
+        # gate replicated (routing needs every logit); expert stacks
+        # shard on ep (storage hints for the GLOBAL [L, E, ...] params)
+        gate = helper.create_parameter(
+            ParamAttr(name=helper.name + ".gate_w"),
+            shape=[L, d, e], dtype=x.dtype,
+            default_initializer=Normal(0., 0.02))
+        w_up = helper.create_parameter(
+            ParamAttr(name=helper.name + ".w_up"),
+            shape=[L, e, d, d_inner], dtype=x.dtype,
+            default_initializer=Normal(0., std))
+        w_down = helper.create_parameter(
+            ParamAttr(name=helper.name + ".w_down"),
+            shape=[L, e, d_inner, d], dtype=x.dtype,
+            default_initializer=Normal(0., d_inner ** -0.5))
+        hints = helper.main_program._sharding_hints
+        hints[gate.name] = ("pp", None, None)
+        hints[w_up.name] = ("pp", "ep", None, None)
+        hints[w_down.name] = ("pp", "ep", None, None)
+        params.update({"GateW": gate, "WUp": w_up, "WDown": w_down})
+    else:
+        params.update({
+            "W1": p(".w1", (L, d, d_inner), Normal(0., std)),
+            "B1": p(".b1", (L, d_inner), Constant(0.0)),
+            "W2": p(".w2", (L, d_inner, d), Normal(0., d_inner ** -0.5)),
+            "B2": p(".b2", (L, d), Constant(0.0)),
+        })
     out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    outputs = {"Out": [out]}
+    aux = None
+    if moe:
+        aux = helper.create_variable_for_type_inference(
+            "float32", shape=())
+        outputs["AuxLoss"] = [aux]
     helper.append_op(
         type="pipeline_stack",
         inputs=dict({"X": [x]}, **{s: [w] for s, w in params.items()}),
-        outputs={"Out": [out]},
+        outputs=outputs,
         attrs={"n_head": n_head, "num_microbatches": num_microbatches,
                "recompute": bool(recompute), "schedule": str(schedule),
-               "virtual_stages": int(virtual_stages)})
+               "virtual_stages": int(virtual_stages),
+               "moe_top_k": int(moe_top_k),
+               "moe_capacity_factor": float(moe_capacity_factor),
+               "moe_gate_groups": int(moe_gate_groups)})
+    if moe:
+        return out, aux
     return out

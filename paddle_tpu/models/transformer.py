@@ -289,8 +289,9 @@ def transformer_lm_parallel(vocab_size=4096, max_len=256, n_layer=4,
                   sp (ring attention inside the stage)
       * sp > 1  → attention via layers.sequence_parallel_attention
                   (ring attention over the sp axis)
-      * num_experts > 0 → FFN via layers.sparse_moe (ep axis; not
-                  composable with pp)
+      * num_experts > 0 → FFN via layers.sparse_moe (ep axis); with
+                  pp > 1 the MoE rides inside the pipeline stage body
+                  (expert shards per stage, all-to-all over ep)
       * tp > 1  → Megatron-style sharding hints on attention/FFN weights
                   (col-shard in-proj, row-shard out-proj; GSPMD inserts
                   the allreduce)
@@ -310,23 +311,33 @@ def transformer_lm_parallel(vocab_size=4096, max_len=256, n_layer=4,
     aux_losses = []
 
     if st.pp > 1:
-        if num_experts > 0:
-            # MoE routing inside the pipeline stage would need the ep
-            # all-to-all nested in the pp shard_map with per-stage expert
-            # placement — refuse rather than silently train a different
-            # model; use ep without pp
-            raise NotImplementedError(
-                "pp>1 does not compose with expert parallelism "
-                "(got experts=%d); use ep without pp" % num_experts)
         # pp x tp: Megatron col/row shards inside the stage body with one
         # psum per sublayer; pp x sp: ring attention over sp inside the
-        # stage (ops/parallel_ops._decoder_layer_apply_tp). dp shards
-        # microbatches throughout.
+        # stage (ops/parallel_ops._decoder_layer_apply_tp); pp x ep: MoE
+        # FFN with the expert all-to-all nested in the stage body
+        # (per-stage expert placement — parallel/moe.moe_ffn_pp_sharded).
+        # dp shards microbatches throughout. MoE routing is
+        # per-microbatch per dp*ep token group, so M and the group count
+        # are pinned STATICALLY from the strategy (the dense fallback
+        # reproduces the exact routing — the dryrun parity contract).
+        schedule = getattr(st, "pp_schedule", "gpipe") or "gpipe"
+        kwargs = {}
+        if num_experts > 0:
+            # M = pp (not gpipe's 2*pp default): each microbatch must
+            # still split into dp*ep token groups, and the smaller M
+            # keeps that feasible at parity-test batch sizes
+            kwargs.update(
+                num_experts=num_experts,
+                moe_gate_groups=(st.dp or 1) * st.ep,
+                num_microbatches=st.pp)
         x = layers.pipelined_decoder_stack(
             x, n_layer, n_head, d_inner,
-            schedule=getattr(st, "pp_schedule", "gpipe") or "gpipe",
+            schedule=schedule,
             virtual_stages=getattr(st, "pp_virtual_stages", 0),
-            tp_shard=st.tp > 1)
+            tp_shard=st.tp > 1, **kwargs)
+        if num_experts > 0:
+            x, pp_aux = x
+            aux_losses.append(pp_aux)
     else:
         for _ in range(n_layer):
             x = _parallel_decoder_layer(x, n_head, d_key, d_value, d_model,

@@ -108,7 +108,8 @@ def _ln_apply(x, scale, bias, eps=1e-5):
     return ((xf - m) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
 
 
-def _decoder_layer_apply_tp(p, x, n_head, tp_axis, sp_axis=None):
+def _decoder_layer_apply_tp(p, x, n_head, tp_axis, sp_axis=None,
+                            ep_axis=None, moe_top_k=1, moe_cf=1.25):
     """Megatron tensor-parallel twin of _decoder_layer_apply, for use
     INSIDE shard_map (the pipeline stage body): p's matrix leaves are the
     LOCAL tp shards — wq/wk/wv col-sharded [d, d/tp] (head-split), wo
@@ -117,7 +118,15 @@ def _decoder_layer_apply_tp(p, x, n_head, tp_axis, sp_axis=None):
     g-operator). LN params and b2 are replicated; b2 adds after the psum.
     With sp_axis set, activations arrive sequence-sharded [b, t/sp, d]
     and attention runs the ring schedule over that axis (the pp x sp
-    composition)."""
+    composition).
+
+    MoE FFN (the pp x ep composition): when p carries gate_w/w_up/w_down
+    instead of w1..b2, the FFN is a routed expert layer and the call
+    returns (out, aux_loss). With ep_axis set, w_up/w_down arrive as the
+    LOCAL expert shards and dispatch rides lax.all_to_all over ep
+    (parallel/moe.moe_ffn_pp_sharded); otherwise the full expert set
+    runs densely on this member's tokens — the same math either way, so
+    the dense fallback's group-wise routing reproduces the sharded run."""
     b, t, d = x.shape
     tp = lax.psum(1, tp_axis) if tp_axis else 1
     h_local = n_head // tp
@@ -138,6 +147,19 @@ def _decoder_layer_apply_tp(p, x, n_head, tp_axis, sp_axis=None):
     if tp_axis:
         part = lax.psum(part, tp_axis)
     x = _ln_apply(x + part, p["ln1_s"], p["ln1_b"])
+    if "gate_w" in p:
+        from ..parallel import moe as moe_mod
+        flat = x.reshape(-1, d)
+        if ep_axis:
+            f, aux = moe_mod.moe_ffn_pp_sharded(
+                flat, p["gate_w"], p["w_up"], p["w_down"], ep_axis,
+                top_k=moe_top_k, capacity_factor=moe_cf)
+        else:
+            f, aux = moe_mod.moe_ffn(
+                flat, p["gate_w"], p["w_up"], p["w_down"],
+                capacity_factor=moe_cf, top_k=moe_top_k)
+        f = f.reshape(b, t, d)
+        return _ln_apply(x + f, p["ln2_s"], p["ln2_b"]), aux
     h = jax.nn.relu(x @ p["w1"] + p["b1"])
     f = h @ p["w2"]
     if tp_axis:
@@ -150,6 +172,54 @@ _STACK_SLOTS = ("WQ", "WK", "WV", "WO", "LN1S", "LN1B", "W1", "B1", "W2",
                 "B2", "LN2S", "LN2B")
 _STACK_KEYS = ("wq", "wk", "wv", "wo", "ln1_s", "ln1_b", "w1", "b1", "w2",
                "b2", "ln2_s", "ln2_b")
+
+
+def _pipeline_moe_fallback(ctx, op, x, params, n_head, gate_groups,
+                           moe_top_k, moe_cf):
+    """Dense single-device twin of the MoE pipeline: scan the SAME M
+    microbatches, and within each, vmap the layer over the same
+    gate_groups contiguous token groups the sharded run splits over
+    dp x ep — routing (capacities, drops, aux) is then identical to the
+    pipelined execution, which is what the dryrun parity check demands.
+    Attention and LN are batch-elementwise, so the group vmap changes
+    nothing for them."""
+    m = int(op.attr("num_microbatches", 0))
+    if m < 1:
+        raise ValueError(
+            "pipeline_stack MoE needs an EXPLICIT num_microbatches: "
+            "routing is per-microbatch, so the dense fallback can only "
+            "reproduce the pipelined model if M is static")
+    b = x.shape[0]
+    g = max(1, gate_groups)
+    if b % m or (b // m) % g:
+        raise ValueError(
+            "pipeline_stack MoE: batch %d must divide into %d "
+            "microbatches x %d gate groups" % (b, m, g))
+    layer_apply = functools.partial(
+        _decoder_layer_apply_tp, n_head=n_head, tp_axis=None,
+        sp_axis=None, ep_axis=None, moe_top_k=moe_top_k, moe_cf=moe_cf)
+    if op.attr("recompute"):
+        layer_apply = jax.checkpoint(layer_apply)
+    per_group = jax.vmap(layer_apply, in_axes=(None, 0))
+
+    def layer_body(carry, layer_p):
+        xg, aux = carry
+        xg2, aux_l = per_group(layer_p, xg)
+        return (xg2, aux + jnp.mean(aux_l).astype(jnp.float32)), None
+
+    def mb_body(aux_total, mb):
+        rows = mb.shape[0]
+        xg = mb.reshape((g, rows // g) + mb.shape[1:])
+        (xg_out, aux_mb), _ = lax.scan(
+            layer_body, (xg, jnp.asarray(0.0, jnp.float32)), params)
+        return aux_total + aux_mb, xg_out.reshape(mb.shape)
+
+    mbs = x.reshape((m, b // m) + x.shape[1:])
+    aux_total, outs = lax.scan(
+        mb_body, jnp.asarray(0.0, jnp.float32), mbs)
+    ctx.set_out(op, "Out", outs.reshape(x.shape))
+    if op.output("AuxLoss"):
+        ctx.set_out(op, "AuxLoss", aux_total / m)
 
 
 # per-leaf PartitionSpec tails (dims AFTER the leading stage/chunk dims)
@@ -181,16 +251,35 @@ def _pipeline_stack(ctx, op):
     Composition: a tp mesh axis Megatron-shards every stage's weights
     (col/row) with one psum per sublayer inside the stage body; an sp
     axis shards the sequence dim and runs ring attention inside the
-    stage (parallel/ring._ring_attention_sharded). dp shards the
-    microbatch dim as before — dp x pp x tp x sp in one shard_map."""
+    stage (parallel/ring._ring_attention_sharded); GateW/WUp/WDown
+    slots replace W1..B2 with a routed MoE FFN whose experts shard on
+    the ep axis and whose dispatch all-to-alls INSIDE the stage body
+    (pp x ep). dp shards the microbatch dim as before — and with MoE
+    the token groups split over dp x ep jointly, at the STATIC
+    granularity attr moe_gate_groups (= dp*ep), so the dense fallback
+    reproduces the pipelined routing exactly. MoE adds the AuxLoss
+    output (live-tick-masked load-balancing loss)."""
     x = ctx.in1(op, "X")
     n_head = int(op.attr("n_head", 8))
     params = {key: ctx.in1(op, slot)
-              for key, slot in zip(_STACK_KEYS, _STACK_SLOTS)}
+              for key, slot in zip(_STACK_KEYS, _STACK_SLOTS)
+              if op.input(slot)}
+    moe = bool(op.input("GateW"))
+    moe_top_k = int(op.attr("moe_top_k", 1))
+    moe_cf = float(op.attr("moe_capacity_factor", 1.25))
+    gate_groups = int(op.attr("moe_gate_groups", 1) or 1)
+    if moe:
+        params["gate_w"] = ctx.in1(op, "GateW")
+        params["w_up"] = ctx.in1(op, "WUp")
+        params["w_down"] = ctx.in1(op, "WDown")
     n_layer = params["wq"].shape[0]
     mesh = _mesh_axis(ctx, "pp")
 
     if mesh is None:
+        if moe:
+            _pipeline_moe_fallback(ctx, op, x, params, n_head,
+                                   gate_groups, moe_top_k, moe_cf)
+            return
         layer_apply = functools.partial(_decoder_layer_apply,
                                         n_head=n_head)
         if op.attr("recompute"):
@@ -206,34 +295,90 @@ def _pipeline_stack(ctx, op):
     from ..parallel import pipeline
     tp_axis = "tp" if _mesh_axis(ctx, "tp") else None
     sp_axis = "sp" if _mesh_axis(ctx, "sp") else None
+    ep_axis = "ep" if (moe and _mesh_axis(ctx, "ep")) else None
+    if moe and sp_axis:
+        raise NotImplementedError(
+            "pipeline_stack MoE does not compose with sequence "
+            "parallelism yet (routing granularity under a sequence "
+            "shard is undefined); use pp x ep without sp")
+    if moe:
+        if int(op.attr("num_microbatches", 0)) < 1:
+            raise ValueError(
+                "pipeline_stack MoE needs an EXPLICIT num_microbatches: "
+                "routing is per-microbatch, so the dense fallback can "
+                "only reproduce the pipelined model if M is static")
+        dp_size = mesh.shape["dp"] if "dp" in mesh.axis_names else 1
+        ep_size = mesh.shape["ep"] if ep_axis else 1
+        if gate_groups != dp_size * ep_size:
+            raise ValueError(
+                "pipeline_stack moe_gate_groups=%d does not match the "
+                "mesh's dp*ep=%d*%d: the static routing granularity "
+                "must equal the token-split so the dense fallback and "
+                "the sharded run gate the same groups"
+                % (gate_groups, dp_size, ep_size))
     if tp_axis:
         tp = mesh.shape["tp"]
-        d_inner = params["w1"].shape[-1]
+        d_inner = params["w1"].shape[-1] if "w1" in params else 0
         if n_head % tp or d_inner % tp:
             raise ValueError(
                 "pipeline_stack tp composition needs n_head (%d) and "
                 "d_inner (%d) divisible by tp=%d" % (n_head, d_inner, tp))
-    if tp_axis or sp_axis:
+    if tp_axis or sp_axis or moe:
         layer_apply = functools.partial(_decoder_layer_apply_tp,
                                         n_head=n_head, tp_axis=tp_axis,
-                                        sp_axis=sp_axis)
+                                        sp_axis=sp_axis, ep_axis=ep_axis,
+                                        moe_top_k=moe_top_k,
+                                        moe_cf=moe_cf)
     else:
         layer_apply = functools.partial(_decoder_layer_apply,
                                         n_head=n_head)
     if op.attr("recompute"):
         layer_apply = jax.checkpoint(layer_apply)
 
-    def stage_fn(stage_params, mb):
-        def body(carry, layer_p):
-            return layer_apply(layer_p, carry), None
+    if moe:
+        def stage_fn(stage_params, mb):
+            def body(carry, layer_p):
+                h, aux = carry
+                h2, aux_l = layer_apply(layer_p, h)
+                return (h2, aux + aux_l.astype(jnp.float32)), None
 
-        out, _ = lax.scan(body, mb, stage_params)
-        return out
+            (out, aux), _ = lax.scan(
+                body, (mb, jnp.asarray(0.0, jnp.float32)), stage_params)
+            return out, aux
+    else:
+        def stage_fn(stage_params, mb):
+            def body(carry, layer_p):
+                return layer_apply(layer_p, carry), None
+
+            out, _ = lax.scan(body, mb, stage_params)
+            return out
 
     s = mesh.shape["pp"]
     schedule = str(op.attr("schedule", "") or "gpipe")
-    param_specs = {k: _TP_SPEC_TAILS[k] for k in params} if tp_axis \
-        else None
+    # per-leaf spec tails (dims after the leading stage/chunk dims):
+    # Megatron col/row tp shards for the dense params, expert-dim ep
+    # shards for the MoE stacks (gate_w stays replicated — routing
+    # needs every expert's logit)
+    if tp_axis or ep_axis:
+        def _tail(key, p):
+            if key in ("w_up", "w_down"):
+                return ((None, "ep") + (None,) * (p.ndim - 3)) \
+                    if ep_axis else (None,) * (p.ndim - 1)
+            if tp_axis and key in _TP_SPEC_TAILS:
+                return _TP_SPEC_TAILS[key]
+            return (None,) * (p.ndim - 1)
+
+        param_specs = {k: _tail(k, p) for k, p in params.items()}
+    else:
+        param_specs = None
+    # MoE token groups split over dp AND ep jointly (each (dp, ep)
+    # member routes its own token slice — the moe_gate_groups contract)
+    if moe:
+        batch_axes = tuple(a for a in ("dp", "ep")
+                           if a in mesh.axis_names and mesh.shape[a] > 1)
+        batch_axis = batch_axes or None
+    else:
+        batch_axis = _batch_axis(mesh)
     b = x.shape[0]
     if schedule == "interleaved":
         v_chunks = int(op.attr("virtual_stages", 0)) or n_layer // s
@@ -257,10 +402,14 @@ def _pipeline_stack(ctx, op):
             raise ValueError("pipeline_stack: batch %d not divisible by "
                              "%d microbatches" % (b, m))
         mb = x.reshape((m, b // m) + x.shape[1:])
+        if moe and (b // m) % gate_groups:
+            raise ValueError(
+                "pipeline_stack MoE: microbatch rows %d not divisible "
+                "by moe_gate_groups=%d" % (b // m, gate_groups))
         out = pipeline.gpipe_interleaved(
             stage_fn, stacked, mb, mesh, v_chunks, axis_name="pp",
-            batch_axis=_batch_axis(mesh), param_specs=param_specs,
-            seq_axis=sp_axis)
+            batch_axis=batch_axis, param_specs=param_specs,
+            seq_axis=sp_axis, with_aux=moe)
     else:
         if n_layer % s:
             raise ValueError("pipeline_stack: %d layers not divisible by "
@@ -273,7 +422,16 @@ def _pipeline_stack(ctx, op):
             raise ValueError("pipeline_stack: batch %d not divisible by "
                              "%d microbatches" % (b, m))
         mb = x.reshape((m, b // m) + x.shape[1:])
+        if moe and (b // m) % gate_groups:
+            raise ValueError(
+                "pipeline_stack MoE: microbatch rows %d not divisible "
+                "by moe_gate_groups=%d" % (b // m, gate_groups))
         out = pipeline.gpipe(stage_fn, stacked, mb, mesh, axis_name="pp",
-                             batch_axis=_batch_axis(mesh),
-                             param_specs=param_specs, seq_axis=sp_axis)
+                             batch_axis=batch_axis,
+                             param_specs=param_specs, seq_axis=sp_axis,
+                             with_aux=moe)
+    if moe:
+        out, aux = out
+        if op.output("AuxLoss"):
+            ctx.set_out(op, "AuxLoss", aux)
     ctx.set_out(op, "Out", out.reshape(x.shape))

@@ -9,6 +9,7 @@ dispatch einsum into the all-to-all over ICI, no manual comm code.
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def top1_gating(logits, capacity, rng=None, noise_std=0.0):
@@ -116,4 +117,54 @@ def moe_ffn(x, gate_w, w_up, w_down, capacity_factor=1.25, rng=None,
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
     if return_stats:
         return out, aux, {"overflow": overflow}
+    return out, aux
+
+
+def moe_ffn_pp_sharded(x, gate_w, w_up_local, w_down_local, ep_axis,
+                       top_k=1, capacity_factor=1.25):
+    """Per-DEVICE MoE FFN for use INSIDE shard_map — the pp x ep
+    composition (the MoE all-to-all nested in the pipeline stage body).
+
+    x             [T_loc, D]: THIS member's token slice (the stage
+                  activations arrive batch-sharded over dp x ep)
+    gate_w        [D, E] replicated (routing needs every expert's logit)
+    w_up_local    [E/n_ep, D, H]: this member's expert shard (expert e's
+                  owner is e // e_loc — the contiguous ep sharding of the
+                  stacked [E, ...] weights)
+    w_down_local  [E/n_ep, H, D]
+
+    Routing is LOCAL (each member gates its own tokens with capacity
+    cf*k*T_loc/E — the standard local-routing MoE deployment); the
+    dispatched token queues ride ONE tiled lax.all_to_all to the expert
+    owners ([E, C, D] -> [E/n, n*C, D]), the expert FFN runs on the
+    local expert shard, and a second all_to_all brings the outputs back.
+    Math per member is EXACTLY moe_ffn(mesh=None) on its token group, so
+    a dense fallback that gates the same groups reproduces this bit-for-
+    float (ops/parallel_ops pipeline_stack moe_gate_groups contract).
+
+    Returns ([T_loc, D], aux_loss_local).
+    """
+    t, d = x.shape
+    n_ep = lax.psum(1, ep_axis)
+    e_loc = w_up_local.shape[0]
+    e = e_loc * n_ep
+    capacity = max(1, int(capacity_factor * top_k * t / e))
+    logits = x @ gate_w
+    if top_k > 1:
+        dispatch, combine, aux, _ = topk_gating(logits, capacity, k=top_k)
+    else:
+        dispatch, combine, aux = top1_gating(logits, capacity)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)       # [E, C, D]
+    # chunk j of the E axis (this member's queues for owner j's experts)
+    # goes to member j; received chunks concatenate on the slot axis:
+    # [E, C, D] -> [E/n, n*C, D] (slot block i = tokens from member i)
+    expert_in = lax.all_to_all(expert_in, ep_axis, split_axis=0,
+                               concat_axis=1, tiled=True)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, w_up_local))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down_local)
+    # inverse movement: slot block i returns to member i, rebuilding the
+    # full [E, C, D] expert-major layout for the local combine
+    expert_out = lax.all_to_all(expert_out, ep_axis, split_axis=1,
+                                concat_axis=0, tiled=True)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
     return out, aux

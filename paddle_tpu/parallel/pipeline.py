@@ -50,20 +50,29 @@ from jax.sharding import PartitionSpec as P
 from ._shard_map import shard_map
 
 
-def _run_ticks(apply, xs, s_idx, n_stage, axis_name):
+def _run_ticks(apply, xs, s_idx, n_stage, axis_name, with_aux=False):
     """The GPipe tick loop for one shard. apply: x -> stage output for
-    THIS stage. xs [M, mb, ...] microbatches (replicated or dp-sharded).
-    Returns [1, M, mb, ...]: final-stage outputs (zeros on other
-    shards). The buffer is allocated per shard (SPMD executes one
-    program), but only the last stage ever writes it."""
+    THIS stage (-> (out, aux) when with_aux). xs [M, mb, ...]
+    microbatches (replicated or dp-sharded). Returns [1, M, mb, ...]
+    final-stage outputs (zeros on other shards) — plus, with_aux, this
+    stage's aux sum over LIVE ticks / M (bubble ticks run on garbage
+    and must not pollute the aux loss). The buffer is allocated per
+    shard (SPMD executes one program), but only the last stage ever
+    writes it."""
     m = xs.shape[0]
 
     def tick(t, carry):
-        state_in, outputs = carry
+        state_in, outputs, aux_sum = carry
         mb_idx = jnp.clip(t, 0, m - 1)
         inject = jnp.where(t < m, xs[mb_idx], jnp.zeros_like(xs[0]))
         inp = jnp.where(s_idx == 0, inject, state_in)
-        out = apply(inp)
+        if with_aux:
+            out, aux = apply(inp)
+            # stage s runs microbatch t - s at tick t
+            live = jnp.logical_and(t - s_idx >= 0, t - s_idx < m)
+            aux_sum = aux_sum + jnp.where(live, aux, 0.0)
+        else:
+            out = apply(inp)
         out_mb = t - (n_stage - 1)
         write = jnp.logical_and(s_idx == n_stage - 1, out_mb >= 0)
         upd = lax.dynamic_update_index_in_dim(
@@ -74,20 +83,23 @@ def _run_ticks(apply, xs, s_idx, n_stage, axis_name):
         state_next = lax.ppermute(
             out, axis_name,
             [(j, (j + 1) % n_stage) for j in range(n_stage)])
-        return state_next, outputs
+        return state_next, outputs, aux_sum
 
     state0 = jnp.zeros_like(xs[0])
     outputs0 = jnp.zeros_like(xs)
-    _, outputs = lax.fori_loop(0, n_stage + m - 1, tick,
-                               (state0, outputs0))
+    _, outputs, aux_sum = lax.fori_loop(
+        0, n_stage + m - 1, tick,
+        (state0, outputs0, jnp.asarray(0.0, jnp.float32)))
     # leading singleton axis: the caller's out_spec shards it on pp, so
     # the global result is [S, M, mb, ...] and slicing [-1] pulls ONLY
     # the last stage's buffer — no collective inside the loop or after
+    if with_aux:
+        return outputs[None], aux_sum / m
     return outputs[None]
 
 
 def _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
-                           n_chunks):
+                           n_chunks, with_aux=False):
     """Virtual-stage tick loop for one shard. apply: (chunk_idx, x) ->
     chunk output for THIS device's local chunk `chunk_idx`. Microbatch i
     is injected at tick i and makes V laps: at hop h (one hop per tick)
@@ -98,7 +110,7 @@ def _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
     total = n_chunks * n_stage
 
     def tick(t, carry):
-        state_in, outputs = carry
+        state_in, outputs, aux_sum = carry
         # the unique hop index on THIS device at tick t: the largest
         # h <= t with h ≡ s_idx (mod S); the microbatch holding it is
         # mb = t - h (live iff mb < M and h < total)
@@ -107,7 +119,11 @@ def _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
         live = jnp.logical_and(h < total, mb < m)
         inject = jnp.where(h == 0, xs[jnp.clip(mb, 0, m - 1)], state_in)
         chunk = jnp.clip(h // n_stage, 0, n_chunks - 1)
-        out = apply(chunk, inject)
+        if with_aux:
+            out, aux = apply(chunk, inject)
+            aux_sum = aux_sum + jnp.where(live, aux, 0.0)
+        else:
+            out = apply(chunk, inject)
         write = jnp.logical_and(live, h == total - 1)
         mb_c = jnp.clip(mb, 0, m - 1)
         upd = lax.dynamic_update_index_in_dim(
@@ -116,26 +132,46 @@ def _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
         state_next = lax.ppermute(
             out, axis_name,
             [(j, (j + 1) % n_stage) for j in range(n_stage)])
-        return state_next, outputs
+        return state_next, outputs, aux_sum
 
     state0 = jnp.zeros_like(xs[0])
     outputs0 = jnp.zeros_like(xs)
-    _, outputs = lax.fori_loop(0, m - 1 + total, tick,
-                               (state0, outputs0))
+    _, outputs, aux_sum = lax.fori_loop(
+        0, m - 1 + total, tick,
+        (state0, outputs0, jnp.asarray(0.0, jnp.float32)))
+    if with_aux:
+        return outputs[None], aux_sum / m
     return outputs[None]
 
 
-def _gpipe_sharded(params, xs, stage_fn, axis_name):
+def _aux_reduce(aux, axis_name, aux_mean_axes):
+    """Stage aux sums add over pp (total over layers); members along the
+    token-splitting axes (dp/ep/sp) hold DIFFERENT token groups, so their
+    auxes average — matching a dense fallback that means over groups.
+    (tp members compute identical values; the pmean is a no-op there.)"""
+    aux = lax.psum(aux, axis_name)
+    for ax in aux_mean_axes or ():
+        aux = lax.pmean(aux, ax)
+    return aux
+
+
+def _gpipe_sharded(params, xs, stage_fn, axis_name, with_aux=False,
+                   aux_mean_axes=()):
     """Stacked (homogeneous) path: params leaves arrive [1, ...] — this
     shard's slice of the [S, ...] stack."""
     s_idx = lax.axis_index(axis_name)
     n_stage = lax.psum(1, axis_name)
     local_params = jax.tree_util.tree_map(lambda p: p[0], params)
-    return _run_ticks(lambda x: stage_fn(local_params, x), xs, s_idx,
-                      n_stage, axis_name)
+    res = _run_ticks(lambda x: stage_fn(local_params, x), xs, s_idx,
+                     n_stage, axis_name, with_aux=with_aux)
+    if with_aux:
+        out, aux = res
+        return out, _aux_reduce(aux, axis_name, aux_mean_axes)
+    return res
 
 
-def _interleaved_sharded(params, xs, stage_fn, axis_name, n_chunks):
+def _interleaved_sharded(params, xs, stage_fn, axis_name, n_chunks,
+                         with_aux=False, aux_mean_axes=()):
     """Interleaved path: params leaves arrive [1, V, ...] — this shard's
     V chunk slices. stage_fn(chunk_params, x) runs ONE chunk."""
     s_idx = lax.axis_index(axis_name)
@@ -148,8 +184,12 @@ def _interleaved_sharded(params, xs, stage_fn, axis_name, n_chunks):
                                                keepdims=False), local)
         return stage_fn(cp, x)
 
-    return _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
-                                  n_chunks)
+    res = _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
+                                 n_chunks, with_aux=with_aux)
+    if with_aux:
+        out, aux = res
+        return out, _aux_reduce(aux, axis_name, aux_mean_axes)
+    return res
 
 
 def _gpipe_hetero(params_seq, xs, stage_fn, axis_name):
@@ -164,7 +204,8 @@ def _gpipe_hetero(params_seq, xs, stage_fn, axis_name):
 
 
 def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
-          batch_axis=None, param_specs=None, seq_axis=None):
+          batch_axis=None, param_specs=None, seq_axis=None,
+          with_aux=False):
     """Run ``stage_fn(params_i, x)`` as an S-stage pipeline.
 
     stacked_params: EITHER a pytree whose leaves have leading dim S
@@ -184,13 +225,25 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
     seq_axis:       mesh axis the T (dim-2) activation dim is sharded on
                     (sequence-parallel composition: the stage_fn must
                     run ring/Ulysses attention over that axis).
-    Returns [M, mb, ...] outputs of the final stage.
+    with_aux:       stage_fn returns (out, aux_scalar) — e.g. the MoE
+                    load-balancing loss (pp x ep). Live-tick aux sums
+                    psum over pp and pmean over the token-splitting
+                    axes; gpipe then returns (outputs, aux). batch_axis
+                    may be a TUPLE of axes (the dp x ep token split).
+    Returns [M, mb, ...] outputs of the final stage (with_aux: a tuple).
     """
     s = mesh.shape[axis_name]
     xspec = P(None, batch_axis, seq_axis)
     out_spec = P(axis_name, None, batch_axis, seq_axis)
+    aux_axes = tuple(a for a in jax.tree_util.tree_leaves(
+        (batch_axis, seq_axis)) if a) if with_aux else ()
+    out_specs = (out_spec, P()) if with_aux else out_spec
 
     if isinstance(stacked_params, (list, tuple)):
+        if with_aux:
+            raise NotImplementedError(
+                "with_aux is not supported on the heterogeneous "
+                "per-stage-params path")
         if len(stacked_params) != s:
             raise ValueError(
                 "per-stage params list has %d entries != %d pipeline "
@@ -218,15 +271,19 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
             is_leaf=lambda x: isinstance(x, (P, tuple)))
     fn = shard_map(
         functools.partial(_gpipe_sharded, stage_fn=stage_fn,
-                          axis_name=axis_name),
-        mesh=mesh, in_specs=(pspec, xspec), out_specs=out_spec,
+                          axis_name=axis_name, with_aux=with_aux,
+                          aux_mean_axes=aux_axes),
+        mesh=mesh, in_specs=(pspec, xspec), out_specs=out_specs,
         check_vma=False)
-    return fn(stacked_params, microbatches)[-1]
+    res = fn(stacked_params, microbatches)
+    if with_aux:
+        return res[0][-1], res[1]
+    return res[-1]
 
 
 def gpipe_interleaved(stage_fn, stacked_params, microbatches, mesh,
                       n_chunks, axis_name="pp", batch_axis=None,
-                      param_specs=None, seq_axis=None):
+                      param_specs=None, seq_axis=None, with_aux=False):
     """Interleaved virtual-stage pipeline (Megatron 1F1B-interleaved
     regime): device d holds the V = n_chunks chunk param slices
     {d, d+S, ...}; bubble = (S-1)/V chunk-times instead of (S-1)
@@ -253,6 +310,9 @@ def gpipe_interleaved(stage_fn, stacked_params, microbatches, mesh,
                 "[S=%d, V=%d, ...]; got %s" % (s, n_chunks, leaf.shape))
     xspec = P(None, batch_axis, seq_axis)
     out_spec = P(axis_name, None, batch_axis, seq_axis)
+    aux_axes = tuple(a for a in jax.tree_util.tree_leaves(
+        (batch_axis, seq_axis)) if a) if with_aux else ()
+    out_specs = (out_spec, P()) if with_aux else out_spec
     if param_specs is None:
         pspec = jax.tree_util.tree_map(lambda _: P(axis_name, None),
                                        stacked_params)
@@ -262,7 +322,11 @@ def gpipe_interleaved(stage_fn, stacked_params, microbatches, mesh,
             is_leaf=lambda x: isinstance(x, (P, tuple)))
     fn = shard_map(
         functools.partial(_interleaved_sharded, stage_fn=stage_fn,
-                          axis_name=axis_name, n_chunks=n_chunks),
-        mesh=mesh, in_specs=(pspec, xspec), out_specs=out_spec,
+                          axis_name=axis_name, n_chunks=n_chunks,
+                          with_aux=with_aux, aux_mean_axes=aux_axes),
+        mesh=mesh, in_specs=(pspec, xspec), out_specs=out_specs,
         check_vma=False)
-    return fn(stacked_params, microbatches)[-1]
+    res = fn(stacked_params, microbatches)
+    if with_aux:
+        return res[0][-1], res[1]
+    return res[-1]
