@@ -25,6 +25,11 @@ FLOPS_PER_IMG_TRAIN = 3 * 4.1e9
 PEAK_BF16 = 197e12
 
 
+def flops_per_token(L, D, FFN, T, V):
+    """Train-step FLOPs per token of a decoder-only LM (3x forward)."""
+    return 3 * (L * (8 * D * D + 4 * D * FFN + 4 * T * D) + 2 * D * V)
+
+
 def _run(argv):
     sys.argv = [sys.argv[0]] + argv
 
@@ -84,25 +89,83 @@ def main():
         except Exception as e:
             print("%s bench failed: %s" % (label, e), file=sys.stderr)
             return None, None
-        flops_tok = 3 * (L * (8 * D * D + 4 * D * FFN + 4 * T * D)
-                         + 2 * D * V)
-        mfu = tps * flops_tok / PEAK_BF16
+        mfu = tps * flops_per_token(L, D, FFN, T, V) / PEAK_BF16
         print("%s MFU %.1f%% (%.0f tok/s)" % (label, mfu * 100, tps),
               file=sys.stderr)
         return tps, mfu
 
-    # bs256: the throughput-saturating batch for the 4L/d512 config —
-    # bs32 is dispatch-latency-bound at less than half this rate
-    # (PERF.md batch sweep)
-    tps, _ = transformer_bench("Transformer-small", bs=256)
-    # the LARGE config (8L d1024 ffn4096 T1024): kept unchanged for
-    # round-over-round comparability
-    tps_large, mfu_large = transformer_bench(
-        "Transformer-large", bs=8, L=8, D=1024, FFN=4096, T=1024)
-    # the XL config — the best honest MFU this chip reaches (width
-    # sweep, PERF.md round 4): 8L d2048 ffn8192 T1024, head dim 128
-    tps_xl, mfu_xl = transformer_bench(
-        "Transformer-XL", bs=8, L=8, D=2048, FFN=8192, T=1024, heads=16)
+    def resnet_repeat():
+        _fresh()
+        _run(["--batch_size", "256", "--iterations", "20",
+              "--skip_batch_num", "3", "--device", "TPU",
+              "--dtype", "bfloat16"])
+        import resnet as rmod
+        try:
+            return float(importlib.reload(rmod).main())
+        except Exception as e:
+            print("resnet repeat failed: %s" % e, file=sys.stderr)
+            return None
+
+    def lstm_repeat():
+        """The reference's strongest published training line: stacked
+        dynamic LSTM (benchmark/README.md 184 ms/batch, h=512 bs=64 on
+        a K40m) — the LoD/bucketing path under perf, not just
+        correctness. Returns ms/batch (lower is better)."""
+        _fresh()
+        _run(["--batch_size", "64", "--hidden_dim", "512",
+              "--iterations", "12", "--skip_batch_num", "2",
+              "--device", "TPU"])
+        try:
+            import stacked_dynamic_lstm as lmod
+            return float(importlib.reload(lmod).main())
+        except Exception as e:
+            print("lstm repeat failed: %s" % e, file=sys.stderr)
+            return None
+
+    # INTERLEAVED repeats (VERDICT r4 #7): the tunnel drifts +-30%
+    # across a session, so each config is measured K times spread across
+    # the whole invocation and reported as median + spread — a
+    # round-over-round delta smaller than the spread is noise.
+    K = max(1, int(os.environ.get("PADDLE_TPU_BENCH_REPEATS", "3")))
+    res_s, large_s, xl_s, lstm_s = [ips], [], [], []
+    tps_small = None
+    for r in range(K):
+        if r > 0:
+            res_s.append(resnet_repeat())
+        if r == 0:
+            # bs256: the throughput-saturating batch for the 4L/d512
+            # config — bs32 is dispatch-latency-bound (PERF.md batch
+            # sweep); one sample (secondary metric)
+            tps_small, _ = transformer_bench("Transformer-small", bs=256)
+        # the LARGE config (8L d1024 ffn4096 T1024): kept unchanged for
+        # round-over-round comparability
+        large_s.append(transformer_bench(
+            "Transformer-large", bs=8, L=8, D=1024, FFN=4096, T=1024)[0])
+        # the XL config — the best honest MFU this chip reaches (width
+        # sweep, PERF.md round 4): 8L d2048 ffn8192 T1024, head dim 128
+        xl_s.append(transformer_bench(
+            "Transformer-XL", bs=8, L=8, D=2048, FFN=8192, T=1024,
+            heads=16)[0])
+        lstm_s.append(lstm_repeat())
+
+    import statistics
+
+    def agg(samples):
+        vals = sorted(v for v in samples if v)
+        if not vals:
+            return None, None, []
+        med = statistics.median(vals)
+        spread = 100.0 * (vals[-1] - vals[0]) / med if med else 0.0
+        return med, round(spread, 1), [round(v, 1) for v in vals]
+
+    ips, res_spread, res_samples = agg(res_s)
+    mfu = ips * FLOPS_PER_IMG_TRAIN / PEAK_BF16
+    large_flops_tok = flops_per_token(L=8, D=1024, FFN=4096, T=1024,
+                                      V=8192)
+    xl_flops_tok = flops_per_token(L=8, D=2048, FFN=8192, T=1024, V=8192)
+    tps_large, large_spread, large_samples = agg(large_s)
+    tps_xl, xl_spread, xl_samples = agg(xl_s)
+    lstm_ms, lstm_spread, lstm_samples = agg(lstm_s)
 
     out = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
@@ -110,15 +173,31 @@ def main():
         "unit": "imgs/sec",
         "vs_baseline": round(float(ips) / baseline, 2),
         "mfu_pct": round(mfu * 100, 1),
+        "repeats": K,
+        "spread_pct": res_spread,
+        "samples": res_samples,
     }
-    if tps is not None:
-        out["transformer_tokens_per_sec_per_chip"] = round(tps, 0)
+    if tps_small is not None:
+        out["transformer_tokens_per_sec_per_chip"] = round(tps_small, 0)
     if tps_large is not None:
         out["transformer_large_tokens_per_sec_per_chip"] = round(tps_large, 0)
-        out["transformer_large_mfu_pct"] = round(mfu_large * 100, 1)
+        out["transformer_large_mfu_pct"] = round(
+            tps_large * large_flops_tok / PEAK_BF16 * 100, 1)
+        out["transformer_large_spread_pct"] = large_spread
+        out["transformer_large_samples"] = large_samples
     if tps_xl is not None:
         out["transformer_xl_tokens_per_sec_per_chip"] = round(tps_xl, 0)
-        out["transformer_xl_mfu_pct"] = round(mfu_xl * 100, 1)
+        out["transformer_xl_mfu_pct"] = round(
+            tps_xl * xl_flops_tok / PEAK_BF16 * 100, 1)
+        out["transformer_xl_spread_pct"] = xl_spread
+        out["transformer_xl_samples"] = xl_samples
+    if lstm_ms is not None:
+        # reference anchor: 184 ms/batch (K40m, h=512 bs=64) — LOWER is
+        # better, so vs_baseline > 1 means faster than the reference
+        out["lstm_ms_per_batch"] = round(lstm_ms, 1)
+        out["lstm_vs_baseline"] = round(184.0 / lstm_ms, 2)
+        out["lstm_spread_pct"] = lstm_spread
+        out["lstm_samples"] = lstm_samples
     print(json.dumps(out))
 
 

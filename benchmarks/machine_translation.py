@@ -39,11 +39,20 @@ def main():
              "lbl_word": mk()}
     tokens = int(mask.sum())
 
-    def step(i):
-        lv, = exe.run(feed=feeds, fetch_list=[avg_cost])
-        float(np.asarray(lv))
+    last = []
 
-    return time_loop(step, args, tokens, "tokens")
+    def step(i):
+        lv, = exe.run(feed=feeds, fetch_list=[avg_cost],
+                      return_numpy=False)
+        last[:] = [lv]
+
+    def sync():
+        # one blocking fetch per timing window (per-step fetches would
+        # measure the sandbox tunnel's ~90ms sync, not the chip)
+        if last:
+            print("loss %.4f" % float(np.asarray(last[0])))
+
+    return time_loop(step, args, tokens, "tokens", sync=sync)
 
 
 if __name__ == "__main__":

@@ -45,11 +45,26 @@ def main():
     t = fluid.create_lod_tensor(ids, [lens])
     ys = rng.randint(0, 2, (args.batch_size, 1)).astype(np.int64)
 
-    def step(i):
-        lv, = exe.run(feed={"words": t, "label": ys}, fetch_list=[loss])
-        float(np.asarray(lv))
+    last = []
 
-    return time_loop(step, args, sum(lens), "tokens")
+    def step(i):
+        lv, = exe.run(feed={"words": t, "label": ys}, fetch_list=[loss],
+                      return_numpy=False)
+        last[:] = [lv]
+
+    def sync():
+        # one blocking fetch per timing window (not per step: the
+        # sandbox tunnel charges ~90ms per sync)
+        if last:
+            print("loss %.4f" % float(np.asarray(last[0])))
+
+    tps = time_loop(step, args, sum(lens), "tokens", sync=sync)
+    # the reference anchor is ms/BATCH (benchmark/README.md:108-117,
+    # 184 ms/batch at h=512 bs=64) — report in its unit
+    ms_per_batch = 1000.0 * sum(lens) / tps
+    print("=> %.1f ms/batch (reference K40m anchor: 184 ms/batch)"
+          % ms_per_batch)
+    return ms_per_batch
 
 
 if __name__ == "__main__":

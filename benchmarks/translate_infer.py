@@ -53,12 +53,21 @@ def main():
         out[:] = [translate(src, mask)]
 
     def sync():
-        jax.block_until_ready(out[0])
+        # block_until_ready is a NO-OP on the axon tunnel (PERF.md
+        # "Measurement variance"); only a device->host VALUE fetch
+        # orders the timeline — pull one element of the decode result
+        leaf = jax.tree_util.tree_leaves(out[0])[0]
+        np.asarray(leaf).ravel()[:1]
 
     # tokens/sec = generated tokens (batch * out_len), beams explored in
     # parallel are the speedup mechanism, not the deliverable
-    return time_loop(step, args, args.batch_size * args.out_len, "tokens",
-                     sync=sync)
+    tps = time_loop(step, args, args.batch_size * args.out_len, "tokens",
+                    sync=sync)
+    # per-decode-step latency at this batch (the deployment metric):
+    # batch_time / out_len = bs / tps
+    print("=> %.2f ms/token (bs=%d beam=%d)"
+          % (1000.0 * args.batch_size / tps, args.batch_size, args.beam))
+    return tps
 
 
 if __name__ == "__main__":

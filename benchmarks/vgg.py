@@ -23,12 +23,20 @@ def main():
     xs = rng.rand(args.batch_size, *shape).astype(np.float32)
     ys = rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64)
 
+    last = []
+
     def step(i):
         lv, = exe.run(feed={"data": xs, "label": ys},
-                      fetch_list=[avg_cost])
-        float(np.asarray(lv))
+                      fetch_list=[avg_cost], return_numpy=False)
+        last[:] = [lv]
 
-    return time_loop(step, args, args.batch_size, "imgs")
+    def sync():
+        # one blocking fetch per timing window (per-step fetches would
+        # measure the sandbox tunnel's ~90ms sync, not the chip)
+        if last:
+            print("loss %.4f" % float(np.asarray(last[0])))
+
+    return time_loop(step, args, args.batch_size, "imgs", sync=sync)
 
 
 if __name__ == "__main__":

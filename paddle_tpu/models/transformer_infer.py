@@ -242,3 +242,69 @@ class TransformerInfer:
         state = self._init_decode_state(enc, src_mask, batch)
         return decoding.greedy_search(self._step_logits, state, self.bos_id,
                                       self.end_id, max_out, batch)
+
+
+class TransformerLMInfer(TransformerInfer):
+    """KV-cached incremental decode for the decoder-only flagship LM
+    (models/transformer.transformer_lm) — the generation path of the
+    reference's RecurrentGradientMachine
+    (gserver/gradientmachines/RecurrentGradientMachine.h:32), rebuilt as
+    one jitted XLA while-loop over a static KV cache. Same param-stream
+    replay as TransformerInfer; the lm builder's per-layer stream (4
+    attention muls, ln, ffn w1/b1/w2/b2, ln) is exactly the encoder
+    layer's, so the cursor helpers are inherited."""
+
+    def __init__(self, program, scope, n_layer, n_head, d_model, max_len,
+                 bos_id=1, end_id=2):
+        self.n_layer, self.n_head = n_layer, n_head
+        self.d_model, self.max_len = d_model, max_len
+        self.bos_id, self.end_id = bos_id, end_id
+        stream = extract_params(program, scope)
+        cur = _Cursor(stream)
+        self.word_emb = cur.take("lookup")
+        self.pos_emb = cur.take("lookup")
+        self.layers = [self._take_attn_ffn(cur) for _ in range(n_layer)]
+        self.w_out = cur.take("mul")
+        cur.done()
+
+    def _init_state(self, rows):
+        dk = self.d_model // self.n_head
+        dtype = self.word_emb.dtype
+        return {("k%d" % i if half == 0 else "v%d" % i):
+                jnp.zeros((rows, self.n_head, self.max_len, dk), dtype)
+                for i in range(self.n_layer) for half in (0, 1)}
+
+    def _step_logits(self, tok, state, t):
+        """One incremental step: tok [rows] i32 → (logits [rows, V],
+        state with this token's K/V written at cache slot t)."""
+        x = self.word_emb[tok] * (self.d_model ** 0.5) + self.pos_emb[t]
+        x = x[:, None, :]
+        pos_mask = (jnp.arange(self.max_len) <= t)
+        self_bias = jnp.where(pos_mask, 0.0, -1e9)[None, None, None, :]
+        for i, p in enumerate(self.layers):
+            k_new, v_new = self._kv(p["attn"], x)
+            k = lax.dynamic_update_slice_in_dim(state["k%d" % i], k_new,
+                                                t, axis=2)
+            v = lax.dynamic_update_slice_in_dim(state["v%d" % i], v_new,
+                                                t, axis=2)
+            state["k%d" % i], state["v%d" % i] = k, v
+            a = self._mha(p["attn"], x, k, v, self_bias)
+            x = _ln(x + a, *p["ln1"])
+            x = _ln(x + self._ffn(p, x), *p["ln2"])
+        return x[:, 0, :] @ self.w_out, state
+
+    def generate(self, batch, max_out_len=None, beam_size=1,
+                 length_penalty=0.0):
+        """Generate from BOS. beam_size=1 → greedy ((tokens [B, T],
+        scores [B])); beam_size>1 → beam search ((tokens [B, beam, T],
+        scores [B, beam]))."""
+        max_out = self._check_out_len(max_out_len)
+        if beam_size > 1:
+            state = self._init_state(batch * beam_size)
+            return decoding.beam_search(
+                self._step_logits, state, self.bos_id, self.end_id,
+                max_out, batch, beam_size, length_penalty)
+        state = self._init_state(batch)
+        return decoding.greedy_search(self._step_logits, state,
+                                      self.bos_id, self.end_id, max_out,
+                                      batch)

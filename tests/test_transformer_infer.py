@@ -86,3 +86,53 @@ def test_extract_params_mismatch_is_loud(rng):
         assert "mismatch" in str(e) or "exhausted" in str(e)
     else:
         raise AssertionError("wrong n_layer must not silently mis-wire")
+
+
+def test_lm_teacher_forced_logit_parity(rng):
+    """TransformerLMInfer replays transformer_lm weights: incremental
+    KV-cached step logits must match the Program's full forward."""
+    from paddle_tpu.models.transformer_infer import TransformerLMInfer
+    avg_cost, logits = transformer.transformer_lm(
+        vocab_size=VOCAB, max_len=MAX_LEN, n_layer=N_LAYER,
+        n_head=N_HEAD, d_model=D_MODEL, d_inner=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    batch = 2
+    src = rng.randint(3, VOCAB, (batch, MAX_LEN)).astype(np.int64)
+    pos = np.tile(np.arange(MAX_LEN, dtype=np.int64), (batch, 1))
+    ones = np.ones((batch, MAX_LEN), np.float32)
+    prog_logits, = exe.run(
+        feed={"src": src, "pos": pos, "mask": ones, "label": src},
+        fetch_list=[logits])
+    prog_logits = np.asarray(prog_logits)
+
+    infer = TransformerLMInfer(fluid.default_main_program(),
+                               fluid.global_scope(), N_LAYER, N_HEAD,
+                               D_MODEL, MAX_LEN)
+    state = infer._init_state(batch)
+    toks = src.astype(np.int32)
+    for t in range(MAX_LEN):
+        step_logits, state = infer._step_logits(
+            jnp.asarray(toks[:, t]), state, t)
+        np.testing.assert_allclose(np.asarray(step_logits),
+                                   prog_logits[:, t, :], rtol=2e-3,
+                                   atol=2e-4)
+
+
+def test_lm_generate_greedy_and_beam(rng):
+    from paddle_tpu.models.transformer_infer import TransformerLMInfer
+    transformer.transformer_lm(
+        vocab_size=VOCAB, max_len=MAX_LEN, n_layer=N_LAYER,
+        n_head=N_HEAD, d_model=D_MODEL, d_inner=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    infer = TransformerLMInfer(fluid.default_main_program(),
+                               fluid.global_scope(), N_LAYER, N_HEAD,
+                               D_MODEL, MAX_LEN)
+    toks, g_scores = infer.generate(batch=2, max_out_len=8)
+    assert np.asarray(toks).shape == (2, 8)
+    assert np.asarray(g_scores).shape == (2,)
+    sents, scores = infer.generate(batch=2, max_out_len=8, beam_size=3)
+    assert np.asarray(sents).shape == (2, 3, 8)
+    sc = np.asarray(scores)
+    assert (np.diff(sc, axis=1) <= 1e-6).all()   # best beam first
