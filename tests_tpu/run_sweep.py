@@ -181,6 +181,15 @@ def aggregate(record_path, pyres):
     return all_ops, per_op, counts
 
 
+def _tols():
+    """The live tolerance policy from tests/op_test.py (keeps the
+    committed report in sync with the code)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import op_test
+    return (op_test._TPU_MXU_RTOL, op_test._TPU_MXU_ATOL,
+            op_test._TPU_F32_RTOL, op_test._TPU_F32_ATOL)
+
+
 def write_reports(all_ops, per_op, counts, pyres):
     stamp = datetime.date.today().isoformat()
     doc = {"date": stamp, "files": FILES, "pytest": pyres,
@@ -206,9 +215,9 @@ def write_reports(all_ops, per_op, counts, pyres):
         f"{counts['fail']} failing, {counts['uncovered']} uncovered",
         "",
         "Tolerance policy (tests/op_test.py): MXU-crossing ops compare "
-        "at rtol 2e-2/atol 2e-3 (default-precision bf16 matmul inputs — "
-        "the same numerics training uses); all other ops at rtol 2e-4/"
-        "atol 2e-5. FD grad checks run under "
+        "at rtol %g/atol %g (default-precision bf16 matmul inputs — "
+        "the same numerics training uses); all other ops at rtol %g/"
+        "atol %g. FD grad checks run under " % _tols() +
         "`jax.default_matmul_precision('highest')` (central differences "
         "divide forward error by 2*delta, so bf16 noise would swamp "
         "them) — still the real MXU, via the f32 multi-pass path.", ""]
