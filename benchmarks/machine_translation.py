@@ -34,10 +34,14 @@ def main():
     pos = np.tile(np.arange(t, dtype=np.int64), (b, 1))
     mk = lambda: (rng.randint(3, args.dict_size, (b, t)) *
                   mask).astype(np.int64)
-    feeds = {"src_word": mk(), "src_pos": pos, "src_mask": mask,
-             "trg_word": mk(), "trg_pos": pos, "trg_mask": mask,
-             "lbl_word": mk()}
     tokens = int(mask.sum())
+    # device-committed once: per-step re-upload of the same batch would
+    # measure the sandbox tunnel, not the chip (see vgg.py note)
+    import jax
+    feeds = {k: jax.device_put(v) for k, v in
+             {"src_word": mk(), "src_pos": pos, "src_mask": mask,
+              "trg_word": mk(), "trg_pos": pos, "trg_mask": mask,
+              "lbl_word": mk()}.items()}
 
     last = []
 

@@ -230,6 +230,59 @@ def jax_twin(args):
     return med
 
 
+def bounds(args):
+    """Isolated bf16 matmul rates at the EXACT shapes the d1024 step
+    runs (default precision — the training numerics), pairing each
+    (m,k)x(k,n) with its (m,n)x(n,k) transpose partner so the chain
+    stays data-dependent (no fusion shortcut). These are the
+    per-component ROOFS the residual table (PERF.md round 5) holds the
+    ablation times against: a component whose ablation-implied rate
+    matches its isolated rate is at bound — the gap is the shape's,
+    not the framework's."""
+    import jax
+    import jax.numpy as jnp
+    n_tok = args.batch_size * args.max_len
+    d, f, v = args.d_model, args.d_inner, args.vocab
+    shapes = [
+        ("qkvo/attn-proj  %dx%d" % (d, d), n_tok, d, d),
+        ("ffn-up  %dx%d" % (d, f), n_tok, d, f),
+        ("ffn-down  %dx%d" % (f, d), n_tok, f, d),
+        ("vocab-head  %dx%d" % (d, v), n_tok, d, v),
+        ("chip-roof  8192^3", 8192, 8192, 8192),
+    ]
+    windows = max(1, int(os.environ.get("PADDLE_TPU_BENCH_WINDOWS", "5")))
+    pairs = 8
+    key = jax.random.key(0)
+    for name, m, k, n in shapes:
+        # generated ON DEVICE: pushing hundreds of MB of host arrays
+        # through the tunnel's few-MB/s upload would stall the probe
+        ks = jax.random.split(key, 5)
+        a = 0.1 * jax.random.normal(ks[0], (m, k), jnp.bfloat16)
+        bs = [0.1 * jax.random.normal(ks[1 + i], (k, n), jnp.bfloat16)
+              for i in range(2)]
+        cs = [0.1 * jax.random.normal(ks[3 + i], (n, k), jnp.bfloat16)
+              for i in range(2)]
+
+        @jax.jit
+        def chain(a, bs=tuple(bs), cs=tuple(cs)):
+            y = a
+            for i in range(pairs):
+                y = (y @ bs[i % 2]) @ cs[i % 2]
+            return y[0, 0]
+
+        float(chain(a))                       # compile + warm
+        times = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            float(chain(a))                   # value fetch = tunnel sync
+            times.append(time.perf_counter() - t0)
+        med = sorted(times)[len(times) // 2]
+        flops = pairs * 2 * (2.0 * m * k * n)
+        print("%-28s %7.1f TF/s  (%4.1f%% of peak; %.2f ms/chain)"
+              % (name, flops / med / 1e12, flops / med / PEAK * 100,
+                 med * 1000), flush=True)
+
+
 def main():
     args = parse_args(
         "perf_probe_transformer", batch_size=8, iterations=10, skip=3,
@@ -241,7 +294,7 @@ def main():
             pr.add_argument("--d_inner", type=int, default=4096),
             pr.add_argument("--vocab", type=int, default=8192),
             pr.add_argument("--mode", type=str, default="ablate",
-                            choices=["ablate", "sweep", "jax"])))
+                            choices=["ablate", "sweep", "jax", "bounds"])))
     os.environ.setdefault("PADDLE_TPU_BENCH_WINDOWS", "5")
     L, D, F, V, Tn = (args.n_layer, args.d_model, args.d_inner, args.vocab,
                       args.max_len)
@@ -256,6 +309,10 @@ def main():
     if args.mode == "jax":
         med = jax_twin(args)
         report_mfu("pure-jax twin", med)
+        return
+
+    if args.mode == "bounds":
+        bounds(args)
         return
 
     if args.mode == "sweep":

@@ -20,8 +20,17 @@ def main():
     exe.run(fluid.default_startup_program())
 
     rng = np.random.RandomState(0)
-    xs = rng.rand(args.batch_size, *shape).astype(np.float32)
-    ys = rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64)
+    # feeds committed to the DEVICE once: re-uploading the same numpy
+    # batch every step would measure the sandbox tunnel's measured
+    # 4-8 MB/s upload path, not the chip (at 224^2 bs64 that is ~5-9
+    # s/step of pure transfer — PERF.md round-5 bandwidth probe). Real
+    # input overlap is benchmarks/input_pipeline.py's job (DeviceLoader
+    # prefetch).
+    import jax
+    xs = jax.device_put(rng.rand(args.batch_size,
+                                 *shape).astype(np.float32))
+    ys = jax.device_put(
+        rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64))
 
     last = []
 

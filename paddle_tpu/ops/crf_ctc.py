@@ -19,28 +19,16 @@ from ..core.registry import register
 
 
 def _pad_batch(ctx, op, slot="Emission"):
-    x = ctx.in1(op, slot)
-    name = op.input(slot)[0]
-    lens = ctx.maybe_get(name + "@LOD")
-    t = x.shape[0]
-    if lens is None:
-        return x[None], jnp.asarray([t], jnp.int32), t
-    n = lens.shape[0]
-    maxlen = min(int(ctx.static_info.get(name + "@MAXLEN", t)), t)
-    starts = jnp.cumsum(lens) - lens
-    rows = starts[:, None] + jnp.arange(maxlen)[None, :]
-    valid = jnp.arange(maxlen)[None, :] < lens[:, None]
-    padded = jnp.where(valid.reshape(n, maxlen, *([1] * (x.ndim - 1))),
-                       x[jnp.clip(rows, 0, t - 1)], 0)
-    return padded, lens, t
+    """rnn_ops' unique-indices pack (fast backward scatter; see
+    _pad_from_lod) with the crf/ctc 3-tuple signature kept."""
+    from .rnn_ops import _pad_from_lod
+    padded, lens, total, _ = _pad_from_lod(ctx, op, slot)
+    return padded, lens, total
 
 
 def _unpad(padded, lens, total):
-    n, tmax = padded.shape[0], padded.shape[1]
-    flat = padded.reshape((n * tmax,) + padded.shape[2:])
-    valid = (jnp.arange(tmax)[None, :] < lens[:, None]).reshape(-1)
-    order = jnp.argsort(~valid, stable=True)
-    return flat[order][:total]
+    from .rnn_ops import _unpad_to_lod
+    return _unpad_to_lod(padded, lens, total)
 
 
 @register("linear_chain_crf")

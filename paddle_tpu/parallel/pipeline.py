@@ -116,7 +116,11 @@ def _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
         # mb = t - h (live iff mb < M and h < total)
         h = t - ((t - s_idx) % n_stage)
         mb = t - h
-        live = jnp.logical_and(h < total, mb < m)
+        # h >= 0 matters: during pipeline FILL a device's congruent hop
+        # is negative (idle tick) — without the bound the aux of the
+        # garbage apply() would be counted (output writes were always
+        # safe: they additionally require h == total-1)
+        live = (h >= 0) & (h < total) & (mb < m)
         inject = jnp.where(h == 0, xs[jnp.clip(mb, 0, m - 1)], state_in)
         chunk = jnp.clip(h // n_stage, 0, n_chunks - 1)
         if with_aux:
