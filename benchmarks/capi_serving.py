@@ -83,6 +83,13 @@ def main():
         # (VERDICT r4 weak #5 — the absolute table cannot be compared
         # to anything; the DELTA is the durable number).
         import time
+        # amp OFF for the baseline regardless of --dtype: the C
+        # binary's embedded interpreter runs the saved program in f32
+        # (it never enables amp), so the delta must compare identical
+        # numerics — the ABI boundary, not bf16-vs-f32 compute
+        from paddle_tpu.amp import enable_amp, amp_enabled
+        prev_amp = amp_enabled()
+        enable_amp(False)
         prog, feed_names, fetch_targets = fluid.io.load_inference_model(
             path, exe)
         rng = np.random.RandomState(0)
@@ -103,6 +110,7 @@ def main():
             print("bs%-3d in-process python p50 %.2f ms -> C-ABI "
                   "overhead %+.2f ms/call" % (bs, p50py, p50c - p50py),
                   flush=True)
+        enable_amp(prev_amp)
     return results
 
 

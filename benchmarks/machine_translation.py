@@ -38,7 +38,8 @@ def main():
     # device-committed once: per-step re-upload of the same batch would
     # measure the sandbox tunnel, not the chip (see vgg.py note)
     import jax
-    feeds = {k: jax.device_put(v) for k, v in
+    dev = get_place(args).jax_device()    # honor --device CPU/TPU
+    feeds = {k: jax.device_put(v, dev) for k, v in
              {"src_word": mk(), "src_pos": pos, "src_mask": mask,
               "trg_word": mk(), "trg_pos": pos, "trg_mask": mask,
               "lbl_word": mk()}.items()}

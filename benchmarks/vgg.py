@@ -27,10 +27,11 @@ def main():
     # input overlap is benchmarks/input_pipeline.py's job (DeviceLoader
     # prefetch).
     import jax
+    dev = get_place(args).jax_device()    # honor --device CPU/TPU
     xs = jax.device_put(rng.rand(args.batch_size,
-                                 *shape).astype(np.float32))
+                                 *shape).astype(np.float32), dev)
     ys = jax.device_put(
-        rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64))
+        rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64), dev)
 
     last = []
 

@@ -59,4 +59,6 @@ def test_understand_sentiment_stacked_lstm():
             accs.append(float(np.asarray(av).ravel()[0]))
         last_acc = float(np.mean(accs))
     assert last < first, (first, last)
+    # ABSOLUTE: binary CE starts at ln(2)=0.693; require real learning
+    assert last < 0.6, (first, last)
     assert last_acc > 0.7, last_acc   # reference threshold: acc converges

@@ -287,3 +287,47 @@ def test_switch_scalar_select():
     exe.run(fluid.default_startup_program())
     got, = exe.run(feed={}, fetch_list=[lr])
     assert abs(float(np.asarray(got)) - 0.01) < 1e-7
+
+
+def test_stacked_lstm_propagates_maxlen_bound():
+    """Regression for the round-5 32x scan-length defect: the bucketed
+    @MAXLEN static bound must survive through a STACKED rnn (the first
+    layer's output feeds the second's pack), or layer 2+ scans the
+    whole bucketed flat total instead of ~max(lens) steps."""
+    from paddle_tpu.core import registry
+    from paddle_tpu.core.executor import _normalize_feeds, _lower_op
+
+    words = fluid.layers.data("words", [1], dtype="int64", lod_level=1)
+    emb = fluid.layers.embedding(words, size=[20, 8])
+    proj1 = fluid.layers.fc(emb, 32)
+    h1, _ = fluid.layers.dynamic_lstm(proj1, size=32, use_peepholes=False)
+    proj2 = fluid.layers.fc(h1, 32)
+    h2, _ = fluid.layers.dynamic_lstm(proj2, size=32, use_peepholes=False)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    lens = [3, 5, 2, 4]
+    ids = np.random.randint(0, 20, (sum(lens), 1)).astype(np.int64)
+    t = fluid.create_lod_tensor(ids, [lens])
+    feed_arrays, static_info = _normalize_feeds({"words": t})
+    assert static_info["words@MAXLEN"] == 8        # next pow2 of 5
+
+    block = fluid.default_main_program().global_block()
+    env = dict(feed_arrays)
+    scope = fluid.global_scope()
+    for n in scope.local_var_names():
+        v = scope.find_var(n)
+        if v is not None:
+            env[n] = v
+    import jax
+    ctx = registry.LowerContext(env, lambda: jax.random.key(0),
+                                block=block, static_info=static_info)
+    for op in block.ops:
+        _lower_op(ctx, op)
+    # BOTH lstm outputs carry the bound; the flat env values stay
+    # bucket-shaped, so without the static entry layer 2 would have
+    # scanned all 16 bucketed rows
+    assert static_info.get(h1.name + "@MAXLEN") == 8
+    assert static_info.get(proj2.name + "@MAXLEN") == 8
+    assert env[h2.name].shape[0] == feed_arrays["words"].shape[0]
