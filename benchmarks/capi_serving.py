@@ -87,30 +87,28 @@ def main():
         # binary's embedded interpreter runs the saved program in f32
         # (it never enables amp), so the delta must compare identical
         # numerics — the ABI boundary, not bf16-vs-f32 compute
-        from paddle_tpu.amp import enable_amp, amp_enabled
-        prev_amp = amp_enabled()
-        enable_amp(False)
-        prog, feed_names, fetch_targets = fluid.io.load_inference_model(
-            path, exe)
-        rng = np.random.RandomState(0)
-        for bs in sorted(results):
-            x = rng.rand(bs, *shape).astype(np.float32)
-            exe.run(prog, feed={feed_names[0]: x},
-                    fetch_list=fetch_targets)           # warm/compile
-            lat = []
-            for _ in range(args.iterations):
-                t0 = time.perf_counter()
-                r, = exe.run(prog, feed={feed_names[0]: x},
-                             fetch_list=fetch_targets)
-                np.asarray(r)
-                lat.append((time.perf_counter() - t0) * 1000)
-            lat.sort()
-            p50py = lat[len(lat) // 2]
-            p50c = results[bs][0]
-            print("bs%-3d in-process python p50 %.2f ms -> C-ABI "
-                  "overhead %+.2f ms/call" % (bs, p50py, p50c - p50py),
-                  flush=True)
-        enable_amp(prev_amp)
+        from paddle_tpu.amp import amp_guard
+        with amp_guard(False):
+            prog, feed_names, fetch_targets = \
+                fluid.io.load_inference_model(path, exe)
+            rng = np.random.RandomState(0)
+            for bs in sorted(results):
+                x = rng.rand(bs, *shape).astype(np.float32)
+                exe.run(prog, feed={feed_names[0]: x},
+                        fetch_list=fetch_targets)       # warm/compile
+                lat = []
+                for _ in range(args.iterations):
+                    t0 = time.perf_counter()
+                    r, = exe.run(prog, feed={feed_names[0]: x},
+                                 fetch_list=fetch_targets)
+                    np.asarray(r)
+                    lat.append((time.perf_counter() - t0) * 1000)
+                lat.sort()
+                p50py = lat[len(lat) // 2]
+                p50c = results[bs][0]
+                print("bs%-3d in-process python p50 %.2f ms -> C-ABI "
+                      "overhead %+.2f ms/call"
+                      % (bs, p50py, p50c - p50py), flush=True)
     return results
 
 

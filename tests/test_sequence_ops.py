@@ -161,3 +161,48 @@ def test_text_classifier_with_sequence_pool_trains():
     # prior — a bias-only fit cannot get here (regression guard for LoD
     # propagation through embedding)
     assert losses[-1] < 0.3, losses[-1]
+
+
+def test_sequence_pool_min_grad_routes_to_winner(rng):
+    """MIN pooling's gradient flows to the stored arg-min row only
+    (the gather-based backward that replaced segment_min autodiff —
+    same remat-safety rework as MAX; ops/sequence_ops._argext_pool)."""
+    x = rng.rand(6, 3).astype(np.float32)
+    lens = [4, 2]
+    xv = fluid.layers.data("xmin", [3], lod_level=1)
+    pooled = fluid.layers.sequence_pool(xv, "min")
+    loss = fluid.layers.reduce_sum(pooled)
+    g, = fluid.calc_gradient([loss], [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    t = fluid.LoDTensor(x)
+    t.set_recursive_sequence_lengths([lens])
+    gv, pv = exe.run(feed={"xmin": t}, fetch_list=[g.name, pooled.name])
+    gv, pv = np.asarray(gv)[:6], np.asarray(pv)
+    np.testing.assert_allclose(pv, np.stack(
+        [x[:4].min(0), x[4:].min(0)]), rtol=1e-6)
+    # exactly one winner row per (segment, feature) gets gradient 1
+    assert gv.sum() == 6.0
+    for s, (a, b) in enumerate(((0, 4), (4, 6))):
+        for f in range(3):
+            w = np.argmin(x[a:b, f])
+            assert gv[a + w, f] == 1.0
+
+
+def test_sequence_pool_max_empty_segment_identity(rng):
+    """A zero-length sequence's MAX pool row is the dtype identity
+    (finfo.min) and leaks no gradient into other rows."""
+    from paddle_tpu.ops.sequence_ops import _argext_pool, _segments
+    import jax.numpy as jnp
+    x = jnp.asarray(rng.rand(5, 2).astype(np.float32))
+    lens = jnp.asarray([3, 0, 2], jnp.int32)
+    seg = _segments(lens, 5)
+    out, idx = _argext_pool(x, seg, 3, lens, is_max=True)
+    out = np.asarray(out)
+    np.testing.assert_allclose(out[0], np.asarray(x[:3]).max(0))
+    np.testing.assert_allclose(out[2], np.asarray(x[3:]).max(0))
+    assert (out[1] == np.finfo(np.float32).min).all()
+
+    import jax
+    g = jax.grad(lambda x: _argext_pool(x, seg, 3, lens, True)[0].sum())(x)
+    # row 0-2 and 3-4 winners get grad; the empty segment adds NOTHING
+    assert float(np.asarray(g).sum()) == 4.0   # 2 features x 2 segments
