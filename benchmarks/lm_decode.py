@@ -35,9 +35,12 @@ def main():
         d_inner=args.d_model * 4)
     exe = fluid.Executor(get_place(args))
     exe.run(fluid.default_startup_program())
+    import jax.numpy as jnp
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
     infer = TransformerLMInfer(fluid.default_main_program(),
                                fluid.global_scope(), args.n_layer,
-                               args.n_head, args.d_model, args.max_len)
+                               args.n_head, args.d_model, args.max_len,
+                               dtype=dtype)
 
     gen = jax.jit(lambda: infer.generate(
         args.batch_size, max_out_len=args.out_len,

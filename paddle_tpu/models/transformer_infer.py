@@ -87,9 +87,11 @@ def _split_heads(x, n_head):
 
 
 def _ln(x, scale, bias, eps=1e-5):
-    mean = jnp.mean(x, -1, keepdims=True)
-    var = jnp.var(x, -1, keepdims=True)
-    return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    mean = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mean) * lax.rsqrt(var + eps) * scale + bias).astype(
+        x.dtype)
 
 
 class TransformerInfer:
@@ -255,7 +257,12 @@ class TransformerLMInfer(TransformerInfer):
     layer's, so the cursor helpers are inherited."""
 
     def __init__(self, program, scope, n_layer, n_head, d_model, max_len,
-                 bos_id=1, end_id=2):
+                 bos_id=1, end_id=2, dtype=None):
+        """dtype=jnp.bfloat16 casts weights AND KV caches to bf16 —
+        halves cache HBM traffic (the beam-reorder/attention cost of
+        each decode step); score softmax and the token log-probs stay
+        f32 (_mha's preferred_element_type + decoding's log_softmax
+        cast), the standard TPU serving precision recipe."""
         self.n_layer, self.n_head = n_layer, n_head
         self.d_model, self.max_len = d_model, max_len
         self.bos_id, self.end_id = bos_id, end_id
@@ -266,6 +273,20 @@ class TransformerLMInfer(TransformerInfer):
         self.layers = [self._take_attn_ffn(cur) for _ in range(n_layer)]
         self.w_out = cur.take("mul")
         cur.done()
+        if dtype is not None:
+            if jnp.dtype(dtype) not in (jnp.dtype(jnp.bfloat16),
+                                        jnp.dtype(jnp.float32)):
+                # _ln's f32-stats upcast and the score/softmax precision
+                # story are built for bf16; fp16's 5-bit exponent would
+                # silently degrade LN statistics
+                raise ValueError(
+                    "TransformerLMInfer dtype must be bfloat16 or "
+                    "float32; got %r" % (dtype,))
+            cast = lambda a: a.astype(dtype) if hasattr(a, "astype") else a
+            self.word_emb = cast(self.word_emb)
+            self.pos_emb = cast(self.pos_emb)
+            self.w_out = cast(self.w_out)
+            self.layers = jax.tree_util.tree_map(cast, self.layers)
 
     def _init_state(self, rows):
         dk = self.d_model // self.n_head

@@ -210,7 +210,7 @@ def test_two_process_sharded_fetch_gather(tmp_path):
 
 
 _PP_WORKER = r"""
-import json, os, sys
+import sys
 sys.path.insert(0, %(repo)r)
 import numpy as np
 import paddle_tpu as fluid

@@ -136,3 +136,33 @@ def test_lm_generate_greedy_and_beam(rng):
     assert np.asarray(sents).shape == (2, 3, 8)
     sc = np.asarray(scores)
     assert (np.diff(sc, axis=1) <= 1e-6).all()   # best beam first
+
+
+def test_lm_bf16_decode_matches_f32_logits(rng):
+    """dtype=bfloat16 serving mode: weights + KV caches in bf16, score
+    softmax/log-probs in f32. Teacher-forced logits stay within bf16
+    tolerance of the f32 replay and generation runs end-to-end."""
+    import jax.numpy as jnp2
+    from paddle_tpu.models.transformer_infer import TransformerLMInfer
+    transformer.transformer_lm(
+        vocab_size=VOCAB, max_len=MAX_LEN, n_layer=N_LAYER,
+        n_head=N_HEAD, d_model=D_MODEL, d_inner=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    f32 = TransformerLMInfer(fluid.default_main_program(),
+                             fluid.global_scope(), N_LAYER, N_HEAD,
+                             D_MODEL, MAX_LEN)
+    bf16 = TransformerLMInfer(fluid.default_main_program(),
+                              fluid.global_scope(), N_LAYER, N_HEAD,
+                              D_MODEL, MAX_LEN, dtype=jnp2.bfloat16)
+    toks = rng.randint(3, VOCAB, (2, 4)).astype(np.int32)
+    s32, s16 = f32._init_state(2), bf16._init_state(2)
+    assert s16["k0"].dtype == jnp2.bfloat16
+    for t in range(4):
+        l32, s32 = f32._step_logits(jnp.asarray(toks[:, t]), s32, t)
+        l16, s16 = bf16._step_logits(jnp.asarray(toks[:, t]), s16, t)
+        np.testing.assert_allclose(np.asarray(l16, np.float32),
+                                   np.asarray(l32), rtol=0.1, atol=0.05)
+    out, scores = bf16.generate(batch=2, max_out_len=6)
+    assert np.asarray(out).shape == (2, 6)
+    assert np.isfinite(np.asarray(scores)).all()
