@@ -95,10 +95,14 @@ def _ln(x, scale, bias, eps=1e-5):
 
 
 class TransformerInfer:
-    """Replays models/transformer.transformer() weights for fast decode."""
+    """Replays models/transformer.transformer() weights for fast decode.
+
+    dtype=jnp.bfloat16 enables the bf16 serving mode (weights + KV
+    caches bf16, score softmax / LN stats / log-probs f32) — see
+    TransformerLMInfer for the measured decode gains."""
 
     def __init__(self, program, scope, n_layer, n_head, d_model, max_len,
-                 bos_id=1, end_id=2):
+                 bos_id=1, end_id=2, dtype=None):
         self.n_layer, self.n_head = n_layer, n_head
         self.d_model, self.max_len = d_model, max_len
         self.bos_id, self.end_id = bos_id, end_id
@@ -114,6 +118,26 @@ class TransformerInfer:
         self.dec_layers = [self._take_dec_layer(cur) for _ in range(n_layer)]
         self.w_out = cur.take("mul")
         cur.done()
+        self._cast_params(dtype)
+
+    def _cast_params(self, dtype):
+        if dtype is None:
+            return
+        if jnp.dtype(dtype) not in (jnp.dtype(jnp.bfloat16),
+                                    jnp.dtype(jnp.float32)):
+            # _ln's f32-stats upcast and the score/softmax precision
+            # story are built for bf16; fp16's 5-bit exponent would
+            # silently degrade LN statistics
+            raise ValueError(
+                "infer dtype must be bfloat16 or float32; got %r"
+                % (dtype,))
+        cast = lambda a: a.astype(dtype) if hasattr(a, "astype") else a
+        for name, val in list(vars(self).items()):
+            if name.startswith("_") or name in (
+                    "n_layer", "n_head", "d_model", "max_len", "bos_id",
+                    "end_id"):
+                continue
+            setattr(self, name, jax.tree_util.tree_map(cast, val))
 
     @staticmethod
     def _take_mha(cur):
@@ -273,20 +297,7 @@ class TransformerLMInfer(TransformerInfer):
         self.layers = [self._take_attn_ffn(cur) for _ in range(n_layer)]
         self.w_out = cur.take("mul")
         cur.done()
-        if dtype is not None:
-            if jnp.dtype(dtype) not in (jnp.dtype(jnp.bfloat16),
-                                        jnp.dtype(jnp.float32)):
-                # _ln's f32-stats upcast and the score/softmax precision
-                # story are built for bf16; fp16's 5-bit exponent would
-                # silently degrade LN statistics
-                raise ValueError(
-                    "TransformerLMInfer dtype must be bfloat16 or "
-                    "float32; got %r" % (dtype,))
-            cast = lambda a: a.astype(dtype) if hasattr(a, "astype") else a
-            self.word_emb = cast(self.word_emb)
-            self.pos_emb = cast(self.pos_emb)
-            self.w_out = cast(self.w_out)
-            self.layers = jax.tree_util.tree_map(cast, self.layers)
+        self._cast_params(dtype)
 
     def _init_state(self, rows):
         dk = self.d_model // self.n_head

@@ -166,3 +166,24 @@ def test_lm_bf16_decode_matches_f32_logits(rng):
     out, scores = bf16.generate(batch=2, max_out_len=6)
     assert np.asarray(out).shape == (2, 6)
     assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_seq2seq_bf16_translate_runs(rng):
+    """bf16 serving mode on the seq2seq decoder too (shared
+    _cast_params): beam translate runs, fp16 rejected loudly."""
+    import jax.numpy as jnp2
+    _build_and_init()
+    bf16 = TransformerInfer(fluid.default_main_program(),
+                            fluid.global_scope(), N_LAYER, N_HEAD,
+                            D_MODEL, MAX_LEN, dtype=jnp2.bfloat16)
+    assert bf16.src_word_emb.dtype == jnp2.bfloat16
+    src = jnp.asarray(rng.randint(3, VOCAB, (2, MAX_LEN)), jnp.int32)
+    mask = jnp.ones((2, MAX_LEN), jnp.float32)
+    sents, scores = bf16.translate(src, mask, beam_size=2, max_out_len=6)
+    assert np.asarray(sents).shape == (2, 2, 6)
+    assert np.isfinite(np.asarray(scores)).all()
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="bfloat16"):
+        TransformerInfer(fluid.default_main_program(),
+                         fluid.global_scope(), N_LAYER, N_HEAD, D_MODEL,
+                         MAX_LEN, dtype=jnp2.float16)
