@@ -1,0 +1,26 @@
+"""paddle_tpu.analysis — jaxpr-level static analyzer ("graph doctor").
+
+The TPU-era replacement for the reference framework's ProgramDesc
+validation: trace any model or train step to a jaxpr (no device
+needed) and run pluggable lint rules over it. Ships six rules:
+
+  R001 dtype-promotion   fp16 creep, bf16 accumulator leaks, dead upcasts
+  R002 recompile-hazard  weak scalars, baked consts, scalar floods
+  R003 sharding-transfer replicated shard_map operands, all-gathers,
+                         host<->device transfers
+  R004 numerical-risk    log/div/rsqrt without guards, unshifted softmax
+  R005 dead-code         dead eqns, unused params/feeds
+  R006 cost-model        per-eqn FLOPs/bytes roll-up + hotspots
+
+API:   check_program(fn, *args) -> Report  (any jittable callable)
+       analyze_model("resnet") / analyze_zoo() over the model zoo
+CLI:   python -m paddle_tpu.analysis --all   (CI gate: exit 1 on errors)
+"""
+
+from .diagnostics import (  # noqa: F401
+    Diagnostic, Report, ERROR, WARNING, INFO, severity_rank)
+from .engine import (  # noqa: F401
+    Analysis, GraphView, Rule, register_rule, registered_rules,
+    default_rules, check_program)
+from .zoo import analyze_model, analyze_zoo, zoo_names  # noqa: F401
+from . import rules  # noqa: F401  (register the built-in rules)

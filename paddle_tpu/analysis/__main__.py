@@ -1,0 +1,79 @@
+"""CLI: python -m paddle_tpu.analysis [models...] [--all] [--json] ...
+
+Runs the static analyzer over zoo models and exits non-zero when any
+diagnostic reaches --fail-on severity (default: error) — the CI gate
+that keeps the model zoo honest without TPU time. Run under
+JAX_PLATFORMS=cpu; tracing never touches a device.
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="jaxpr static analyzer over the paddle_tpu model "
+                    "zoo")
+    p.add_argument("models", nargs="*",
+                   help="zoo model names (see --list-models)")
+    p.add_argument("--all", action="store_true",
+                   help="analyze every model in the zoo")
+    p.add_argument("--json", action="store_true",
+                   help="emit a JSON report instead of text")
+    p.add_argument("--rules",
+                   help="comma-separated rule names to run "
+                        "(default: all)")
+    p.add_argument("--fail-on", default="error",
+                   choices=["error", "warning", "info"],
+                   help="exit 1 if any diagnostic reaches this "
+                        "severity (default: error)")
+    p.add_argument("--verbose", "-v", action="store_true",
+                   help="include info-level diagnostics in text "
+                        "output")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--list-models", action="store_true")
+    args = p.parse_args(argv)
+
+    from . import registered_rules, zoo_names
+    from .zoo import analyze_zoo
+
+    if args.list_rules:
+        for name, cls in sorted(registered_rules().items(),
+                                key=lambda kv: kv[1].id):
+            print("%-6s %-18s %s" % (cls.id, name, cls.doc))
+        return 0
+    if args.list_models:
+        for name in zoo_names():
+            print(name)
+        return 0
+
+    names = zoo_names() if args.all or not args.models else args.models
+    unknown = set(names) - set(zoo_names())
+    if unknown:
+        p.error("unknown model(s) %s; --list-models for the zoo"
+                % ", ".join(sorted(unknown)))
+    rules = args.rules.split(",") if args.rules else None
+    if rules:
+        bad = set(rules) - set(registered_rules())
+        if bad:
+            p.error("unknown rule(s) %s; --list-rules for the catalog"
+                    % ", ".join(sorted(bad)))
+
+    def progress(name, report, dt):
+        if not args.json:
+            c = report.counts()
+            print("analyzed %-18s %5.1fs  %d error(s) %d warning(s)"
+                  % (name, dt, c["error"], c["warning"]),
+                  file=sys.stderr)
+
+    report = analyze_zoo(names, rules=rules, progress=progress)
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render_text(verbose=args.verbose))
+    return 1 if report.at_least(args.fail_on) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

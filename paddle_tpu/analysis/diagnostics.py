@@ -1,0 +1,120 @@
+"""Structured diagnostics for the jaxpr static analyzer.
+
+The analog of the reference's compile-time Program validation output
+(operator registry attr checks raise EnforceNotMet with an op context);
+here every finding is a structured record so the CLI can render text or
+JSON and CI can gate on severity without parsing messages.
+"""
+
+import json
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_RANK = {INFO: 0, WARNING: 1, ERROR: 2}
+
+
+def severity_rank(sev):
+    try:
+        return _SEVERITY_RANK[sev]
+    except KeyError:
+        raise ValueError("unknown severity %r (use %s)"
+                         % (sev, "/".join(_SEVERITY_RANK)))
+
+
+class Diagnostic:
+    """One finding: rule id + severity + op path + message (+ fix hint).
+
+    ``path`` is the op path of the offending eqn — the executor lowers
+    every Program op under a ``jax.named_scope("<op_type>.<seq>")``, so
+    paths read like ``scan[3]/fc.12/dot_general`` and point back to the
+    Program op that produced the jaxpr region.
+    """
+
+    __slots__ = ("rule", "severity", "message", "path", "hint", "model",
+                 "cost_flops")
+
+    def __init__(self, rule, severity, message, path="", hint="",
+                 model="", cost_flops=None):
+        severity_rank(severity)  # validate
+        self.rule = rule
+        self.severity = severity
+        self.message = message
+        self.path = path
+        self.hint = hint
+        self.model = model
+        self.cost_flops = cost_flops
+
+    def to_dict(self):
+        d = {"rule": self.rule, "severity": self.severity,
+             "message": self.message, "path": self.path}
+        if self.hint:
+            d["hint"] = self.hint
+        if self.model:
+            d["model"] = self.model
+        if self.cost_flops is not None:
+            d["cost_flops"] = self.cost_flops
+        return d
+
+    def __repr__(self):
+        return "Diagnostic(%s, %s, %r)" % (self.rule, self.severity,
+                                           self.message)
+
+
+class Report:
+    """Diagnostics from one ``check_program`` run (or a merged zoo run)."""
+
+    def __init__(self, diagnostics=(), model=""):
+        self.model = model
+        self.diagnostics = list(diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def extend(self, other):
+        self.diagnostics.extend(other)
+        return self
+
+    def by_severity(self, severity):
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    def at_least(self, severity):
+        floor = severity_rank(severity)
+        return [d for d in self.diagnostics
+                if severity_rank(d.severity) >= floor]
+
+    def counts(self):
+        c = {ERROR: 0, WARNING: 0, INFO: 0}
+        for d in self.diagnostics:
+            c[d.severity] += 1
+        return c
+
+    def render_text(self, verbose=False):
+        lines = []
+        order = sorted(self.diagnostics,
+                       key=lambda d: (-severity_rank(d.severity),
+                                      d.model, d.rule))
+        for d in order:
+            if not verbose and d.severity == INFO:
+                continue
+            loc = " @ %s" % d.path if d.path else ""
+            tag = ("[%s]" % d.model) if d.model else ""
+            lines.append("%-7s %s %s: %s%s"
+                         % (d.severity.upper(), tag, d.rule, d.message,
+                            loc))
+            if d.hint:
+                lines.append("        hint: %s" % d.hint)
+        c = self.counts()
+        lines.append("-- %d error(s), %d warning(s), %d info"
+                     % (c[ERROR], c[WARNING], c[INFO]))
+        return "\n".join(lines)
+
+    def to_json(self):
+        return json.dumps(
+            {"model": self.model, "counts": self.counts(),
+             "diagnostics": [d.to_dict() for d in self.diagnostics]},
+            indent=2, sort_keys=True)

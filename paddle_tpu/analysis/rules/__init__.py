@@ -1,0 +1,10 @@
+"""Built-in lint rules. Importing this package populates the registry;
+add new rules by defining a Rule subclass with @register_rule anywhere
+and importing it before check_program runs."""
+
+from . import dtypes        # noqa: F401  R001 dtype-promotion
+from . import recompile     # noqa: F401  R002 recompile-hazard
+from . import sharding      # noqa: F401  R003 sharding-transfer
+from . import numerics      # noqa: F401  R004 numerical-risk
+from . import deadcode      # noqa: F401  R005 dead-code
+from . import cost_rule     # noqa: F401  R006 cost-model

@@ -1,0 +1,101 @@
+"""R005 dead-output / unused-param detection.
+
+make_jaxpr performs no DCE, so everything the Program traced is in the
+graph — eqns whose outputs never reach an output are pure waste (XLA
+will DCE them, but they still bloat trace/compile time and usually
+indicate a builder bug: a head that was never wired into the loss, a
+fetch that was dropped). Unused *inputs* are the sharper signal: a
+parameter that no eqn consumes trains nothing — exactly the "layer
+defined but never called" bug class the reference's ProgramDesc
+validation could not see either.
+"""
+
+import jax
+
+from ..diagnostics import Diagnostic, WARNING, INFO
+from ..engine import Rule, register_rule, Var
+from ..cost import fmt_flops
+
+
+def _is_key(aval):
+    """PRNG key arrays carry an extended dtype."""
+    try:
+        return jax.dtypes.issubdtype(aval.dtype, jax.dtypes.extended)
+    except Exception:
+        return False
+
+
+@register_rule
+class DeadCodeRule(Rule):
+    name = "dead-code"
+    id = "R005"
+    doc = ("eqns that reach no output (dead compute) and inputs no eqn "
+           "consumes (unused params / feeds)")
+
+    def __init__(self, report_top=5, warn_flops=1e6):
+        self.report_top = report_top
+        # below this, dead eqns are trace residue (autodiff leftovers
+        # XLA DCEs for free) — report as info, not warning
+        self.warn_flops = warn_flops
+
+    def check(self, a):
+        jaxpr = a.closed_jaxpr.jaxpr
+        root = a.root
+
+        # ---- unused inputs: no eqn (at any depth reachable from root)
+        # reads them. Root invars only occur in root-level eqns.
+        outvar_set = {v for v in jaxpr.outvars if isinstance(v, Var)}
+        for var in jaxpr.invars:
+            if var in root.consumers:
+                continue
+            aval = getattr(var, "aval", None)
+            if aval is not None and _is_key(aval):
+                # unused RNG key: normal for eval/no-dropout graphs
+                yield Diagnostic(
+                    self.name, INFO,
+                    "RNG key %s is unused (no stochastic ops traced)"
+                    % a.label(var))
+                continue
+            if var in outvar_set:
+                yield Diagnostic(
+                    self.name, WARNING,
+                    "input %s is passed through to the outputs but "
+                    "consumed by no computation — a parameter that "
+                    "trains nothing / a feed that affects nothing"
+                    % a.label(var),
+                    hint="wire it into the graph or drop it from "
+                         "state/feeds")
+            else:
+                yield Diagnostic(
+                    self.name, WARNING,
+                    "input %s is completely unused" % a.label(var),
+                    hint="drop it from the step signature")
+
+        # ---- dead eqns at the root level: backward liveness from the
+        # outputs; an eqn with effects (io/collectives with tokens) is
+        # always live.
+        live = set(outvar_set)
+        dead = []
+        for eqn in reversed(jaxpr.eqns):
+            if getattr(eqn, "effects", None) or \
+                    any(v in live for v in eqn.outvars
+                        if isinstance(v, Var)):
+                for v in eqn.invars:
+                    if isinstance(v, Var):
+                        live.add(v)
+            else:
+                dead.append(eqn)
+        if not dead:
+            return
+        dead_flops = sum(a.costs.flops(e) for e in dead)
+        top = sorted(dead, key=a.costs.flops,
+                     reverse=True)[:self.report_top]
+        sev = WARNING if dead_flops >= self.warn_flops else INFO
+        yield Diagnostic(
+            self.name, sev,
+            "%d dead eqn(s) reach no output (~%s wasted if compiled "
+            "without DCE); heaviest: %s"
+            % (len(dead), fmt_flops(dead_flops),
+               ", ".join(root.eqn_path(e) for e in top[:3])),
+            hint="a fetch/loss wiring bug or leftover debug head — "
+                 "remove the producing layers")

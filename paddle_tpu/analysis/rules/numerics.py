@@ -1,0 +1,117 @@
+"""R004 numerical-risk lint.
+
+Pattern checks for the classic TPU-training footguns: log/div/rsqrt
+reached by values that can hit zero with no epsilon/clamp guard, and
+softmax/logsumexp built without max-subtraction (exp overflow). The
+"guard" whitelist mirrors the idioms the shipped ops actually use —
+log(clip(x, eps)) in cross_entropy, log(p + eps) in sigmoid CE,
+rsqrt(var + eps) in layer/batch norm, and the jax.nn softmax chain
+(sub of a stop_gradient'ed reduce_max before exp).
+"""
+
+from ..diagnostics import Diagnostic, WARNING
+from ..engine import Rule, register_rule, Literal
+
+# producers that bound their output away from the singular point.
+# NOT sqrt/abs: they preserve zero, so dividing by them is as risky as
+# dividing by their operand.
+_GUARDS = {"add", "max", "clamp", "log1p", "xlogy", "exp", "logistic",
+           "integer_pow", "rsqrt",
+           # select(cond, fallback, x) IS the guard idiom (masked
+           # softmax denominators, where-protected divisions)
+           "select_n"}
+
+
+def _is_shifted_exp_sum(a, view, var):
+    """True if ``var`` is reduce_sum(exp(x - max(x))) — the logsumexp /
+    softmax normalizer, which is >= 1 by construction."""
+    view, eqn = a.resolve_producer(view, var)
+    if eqn is None or eqn.primitive.name != "reduce_sum":
+        return False
+    view2, exp_eqn = a.resolve_producer(view, eqn.invars[0])
+    if exp_eqn is None or exp_eqn.primitive.name != "exp":
+        return False
+    _, sub_eqn = a.resolve_producer(view2, exp_eqn.invars[0])
+    return sub_eqn is not None and sub_eqn.primitive.name == "sub"
+
+
+def _guarded(a, view, var, _depth=0):
+    """Heuristic: the value's real producer bounds it away from 0/inf
+    (x + eps, max(x, c), clamp, exp, select-fallbacks ...), or it is a
+    literal/const/plain input (assumed owned by the caller)."""
+    if isinstance(var, Literal):
+        return True
+    rview, eqn = a.resolve_producer(view, var)
+    if eqn is None:
+        return True     # program input or constant — caller's contract
+    prim = eqn.primitive.name
+    if prim in _GUARDS:
+        return True
+    if prim in ("sqrt", "abs") and _depth < 8:
+        # zero-preserving: sqrt(x)/|x| is safe exactly when x is —
+        # sqrt(var + eps) (the batch_norm denominator) passes, a bare
+        # sqrt(var) does not
+        return _guarded(a, rview, eqn.invars[0], _depth + 1)
+    if prim == "sub" and isinstance(eqn.invars[0], Literal):
+        # c - x with a literal c: the Adam/LAMB bias-correction shape
+        # (1 - beta^t), bounded away from 0 for every real step count
+        return True
+    return _is_shifted_exp_sum(a, view, var)
+
+
+@register_rule
+class NumericalRiskRule(Rule):
+    name = "numerical-risk"
+    id = "R004"
+    doc = ("log/div/rsqrt without epsilon or clamp guards; softmax/"
+           "logsumexp built without max-subtraction")
+
+    def check(self, a):
+        for view, eqn in a.iter_eqns():
+            prim = eqn.primitive.name
+            if prim == "log":
+                if not _guarded(a, view, eqn.invars[0]):
+                    yield Diagnostic(
+                        self.name, WARNING,
+                        "log of an unguarded computed value — "
+                        "log(0) = -inf poisons the loss and every "
+                        "gradient behind it",
+                        path=view.eqn_path(eqn),
+                        hint="log(clip(x, eps)) or log(x + eps) "
+                             "(ops/loss.py idiom)")
+            elif prim == "div":
+                if not _guarded(a, view, eqn.invars[1]):
+                    yield Diagnostic(
+                        self.name, WARNING,
+                        "division by an unguarded computed value — "
+                        "a zero denominator (empty mask, dead batch) "
+                        "yields inf/nan",
+                        path=view.eqn_path(eqn),
+                        hint="divide by maximum(x, eps) or add eps")
+            elif prim == "rsqrt":
+                if not _guarded(a, view, eqn.invars[0]):
+                    yield Diagnostic(
+                        self.name, WARNING,
+                        "rsqrt of an unguarded computed value — "
+                        "rsqrt(0) = inf (variance of a constant "
+                        "feature does this)",
+                        path=view.eqn_path(eqn),
+                        hint="rsqrt(var + eps), the layer_norm idiom")
+            elif prim == "exp":
+                # exp feeding a sum (softmax/logsumexp normalizer)
+                # must be max-shifted or large logits overflow
+                users = view.consumers.get(eqn.outvars[0], [])
+                if not any(u.primitive.name == "reduce_sum"
+                           for u in users):
+                    continue
+                _sv, shift = a.resolve_producer(view, eqn.invars[0])
+                if shift is None or shift.primitive.name != "sub":
+                    yield Diagnostic(
+                        self.name, WARNING,
+                        "softmax/logsumexp normalizer without max-"
+                        "subtraction — exp of raw scores overflows "
+                        "past ~88 (f32) / ~127 (bf16 exponent ok but "
+                        "f32 sum still saturates)",
+                        path=view.eqn_path(eqn),
+                        hint="subtract stop_gradient(max(x)) before "
+                             "exp (jax.nn.softmax does this)")

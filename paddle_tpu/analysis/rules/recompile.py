@@ -1,0 +1,71 @@
+"""R002 recompilation-hazard detector.
+
+The jit cache fragments on signature changes the caller never meant to
+vary: weak-typed Python scalars (dtype follows the *value* context),
+large arrays captured by closure (baked as jaxpr consts — re-traced per
+object identity), and scalar floods (hundreds of 0-d args instead of
+one stacked array). All three are visible in the traced signature
+without running anything — the static analog of watching
+jax.monitoring recompile counters in production.
+"""
+
+from ..diagnostics import Diagnostic, WARNING, INFO
+from ..engine import Rule, register_rule, aval_nbytes
+
+
+@register_rule
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    id = "R002"
+    doc = ("weak-typed scalar args, large closure-captured constants, "
+           "and 0-d argument floods that fragment the jit cache")
+
+    def __init__(self, const_min_bytes=1 << 20, scalar_flood=32):
+        self.const_min_bytes = const_min_bytes
+        self.scalar_flood = scalar_flood
+
+    def check(self, a):
+        jaxpr = a.closed_jaxpr.jaxpr
+        n_scalar = 0
+        for var in jaxpr.invars:
+            aval = getattr(var, "aval", None)
+            if aval is None:
+                continue
+            if getattr(aval, "weak_type", False):
+                yield Diagnostic(
+                    self.name, WARNING,
+                    "weak-typed scalar argument %s — a bare Python "
+                    "number; its dtype re-resolves per call context "
+                    "and mixed uses split the jit cache"
+                    % a.label(var),
+                    hint="wrap with np.asarray(x, dtype) or jnp.* "
+                         "so the signature dtype is pinned")
+            if getattr(aval, "shape", None) == ():
+                n_scalar += 1
+        if n_scalar >= self.scalar_flood:
+            yield Diagnostic(
+                self.name, WARNING,
+                "%d scalar (0-d) arguments in the jit signature — "
+                "every distinct combination is a fresh cache entry "
+                "and argument-handling overhead grows linearly"
+                % n_scalar,
+                hint="stack related scalars into one array argument")
+        for const in a.closed_jaxpr.consts:
+            nb = aval_nbytes(const.aval) if hasattr(const, "aval") \
+                else float(getattr(const, "nbytes", 0))
+            if nb >= self.const_min_bytes:
+                shape = getattr(const, "shape", ())
+                yield Diagnostic(
+                    self.name, WARNING,
+                    "large constant baked into the graph (%s, %.1f "
+                    "MiB) — captured by closure, so a new object "
+                    "identity means a full re-trace and re-transfer"
+                    % (list(shape), nb / (1 << 20)),
+                    hint="pass it as a function argument (donated "
+                         "state) instead of closing over it")
+        # informational: how much of the signature is traced state
+        yield Diagnostic(
+            self.name, INFO,
+            "jit signature: %d args (%d scalar), %d baked consts"
+            % (len(jaxpr.invars), n_scalar,
+               len(a.closed_jaxpr.consts)))

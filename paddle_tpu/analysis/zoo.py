@@ -1,0 +1,39 @@
+"""Run the analyzer over the model zoo.
+
+Each model module exposes an ``analysis_entry*()`` (see
+models/harness.py) returning ``(fn, example_args)`` — the same
+(state, feeds, key) -> (fetches, new_state, ...) step the Executor
+jits, so the analyzer sees exactly the graph that would run on TPU.
+Everything here is device-free: tracing is abstract and startup
+initialization runs on whatever JAX_PLATFORMS provides (cpu in CI).
+"""
+
+import time
+
+from .diagnostics import Report
+from .engine import check_program
+
+
+def zoo_names():
+    from ..models import ZOO
+    return sorted(ZOO)
+
+
+def analyze_model(name, rules=None):
+    """Build + trace one zoo model and lint it. Returns a Report."""
+    from ..models import zoo_entry
+    fn, args = zoo_entry(name)
+    return check_program(fn, *args, rules=rules, name=name)
+
+
+def analyze_zoo(names=None, rules=None, progress=None):
+    """Lint every requested model (default: the whole zoo) into one
+    merged Report. ``progress``: optional callable(name, report, dt)."""
+    merged = Report(model="zoo")
+    for name in (names or zoo_names()):
+        t0 = time.time()
+        report = analyze_model(name, rules=rules)
+        if progress is not None:
+            progress(name, report, time.time() - t0)
+        merged.extend(report)
+    return merged

@@ -1034,7 +1034,15 @@ def _lower_op(ctx, op):
             "no TPU lowering registered for op %r (registered: %d ops)"
             % (op.type, len(registry.registered_ops())))
     try:
-        info.lower(ctx, op)
+        # scope every op's lowering as "<op_type>.<seq>": the name lands
+        # in each jaxpr eqn's source_info name stack, which is (a) the
+        # op path paddle_tpu.analysis diagnostics report and (b) the
+        # metadata XLA profiles attribute — the analog of the
+        # reference's per-op RecordEvent naming
+        seq = getattr(ctx, "_op_seq", 0)
+        ctx._op_seq = seq + 1
+        with jax.named_scope("%s.%d" % (op.type, seq)):
+            info.lower(ctx, op)
     except EnforceError:
         raise
     except Exception as e:  # annotate with op context (enforce.h:203 parity)

@@ -1,6 +1,39 @@
 """Model zoo: Program-building functions for the reference's benchmark
 models (benchmark/fluid/{mnist,resnet,vgg,machine_translation,
 stacked_dynamic_lstm}.py + tests/unittests/transformer_model.py), built
-TPU-first with the paddle_tpu layers DSL."""
+TPU-first with the paddle_tpu layers DSL.
+
+``ZOO`` maps every workload to its static-analyzer entry point — a
+callable returning ``(fn, example_args)`` for
+``paddle_tpu.analysis.check_program`` (see models/harness.py). Modules
+resolve lazily so listing the zoo stays import-cheap.
+"""
+
+import importlib
 
 from . import mlp, resnet, ssd, vgg  # noqa: F401
+
+# name -> (module, entry attribute). Every entry traces device-free.
+ZOO = {
+    "mlp": ("paddle_tpu.models.mlp", "analysis_entry"),
+    "cnn": ("paddle_tpu.models.mlp", "analysis_entry_cnn"),
+    "resnet": ("paddle_tpu.models.resnet", "analysis_entry"),
+    "vgg": ("paddle_tpu.models.vgg", "analysis_entry"),
+    "ssd": ("paddle_tpu.models.ssd", "analysis_entry"),
+    "deepfm": ("paddle_tpu.models.deepfm", "analysis_entry"),
+    "transformer": ("paddle_tpu.models.transformer", "analysis_entry"),
+    "transformer_moe": ("paddle_tpu.models.transformer",
+                        "analysis_entry_moe"),
+    "transformer_infer": ("paddle_tpu.models.transformer_infer",
+                          "analysis_entry_infer"),
+}
+
+
+def zoo_entry(name):
+    """Resolve + call a zoo entry: returns (fn, example_args)."""
+    try:
+        mod_name, attr = ZOO[name]
+    except KeyError:
+        raise KeyError("unknown zoo model %r (have: %s)"
+                       % (name, ", ".join(sorted(ZOO))))
+    return getattr(importlib.import_module(mod_name), attr)()

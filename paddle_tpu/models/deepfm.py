@@ -68,3 +68,24 @@ def build_train_net(num_fields=8, vocab_size=1000, embed_dim=8,
         fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
     fluid.optimizer.Adam(learning_rate=learning_rate).minimize(loss)
     return fields, label, prob, loss
+
+
+def analysis_entry():
+    """Static-analyzer entry: DeepFM CTR Adam train step (sparse
+    embedding lookups + FM interactions)."""
+    import numpy as np
+    from .harness import program_entry
+    num_fields, vocab = 8, 1000
+
+    def build():
+        _, _, prob, loss = build_train_net(num_fields=num_fields,
+                                           vocab_size=vocab)
+        return loss, prob
+
+    def feeds(rng):
+        f = {"field_%d" % i: rng.randint(0, vocab, (8, 1))
+             .astype(np.int64) for i in range(num_fields)}
+        f["click"] = rng.randint(0, 2, (8, 1)).astype(np.float32)
+        return f
+
+    return program_entry(build, feeds)

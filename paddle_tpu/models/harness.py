@@ -1,0 +1,41 @@
+"""Shared analyzer entry-point harness for the model zoo.
+
+``program_entry(build_fn, feed_fn)`` stages a model exactly the way the
+Executor would run it — build the Program, run startup init, extract
+state, and return the pure ``step(state, feeds, key)`` the jit would
+compile — so paddle_tpu.analysis lints the real training/inference
+graph, not a simplified stand-in. Each models/* module wraps this in a
+small ``analysis_entry()`` so the zoo registry (models/__init__.ZOO)
+can enumerate every workload.
+"""
+
+import numpy as np
+
+
+def program_entry(build_fn, feed_fn, seed=0):
+    """(fn, example_args) for the analyzer.
+
+    build_fn() -> fetch Variables (called under fresh program guards);
+    feed_fn(rng) -> feed dict (arrays or LoDTensors).
+    """
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.core import executor as core_exec
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fetch_vars = build_fn()
+        if not isinstance(fetch_vars, (tuple, list)):
+            fetch_vars = (fetch_vars,)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+    state = {n: np.asarray(scope.find_var(n))
+             for n in scope.local_var_names()
+             if scope.find_var(n) is not None}
+    feeds = feed_fn(np.random.RandomState(seed))
+    feed_arrays, static_info = core_exec._normalize_feeds(feeds)
+    fn = exe._build(main, tuple(sorted(feed_arrays)),
+                    tuple(v.name for v in fetch_vars),
+                    tuple(sorted(state)), static_info=static_info)
+    return fn, (state, feed_arrays, jax.random.key(seed))

@@ -28,3 +28,39 @@ def cnn(img, label, num_classes=10):
     avg_cost = fluid.layers.mean(cost)
     acc = fluid.layers.accuracy(prediction, label)
     return prediction, avg_cost, acc
+
+
+def analysis_entry():
+    """Static-analyzer entry: MLP Adam train step (see models/harness)."""
+    from .harness import program_entry
+
+    def build():
+        img = fluid.layers.data("img", [784])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        _, avg_cost, acc = mlp(img, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        return avg_cost, acc
+
+    def feeds(rng):
+        return {"img": rng.rand(8, 784).astype("float32"),
+                "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+
+    return program_entry(build, feeds)
+
+
+def analysis_entry_cnn():
+    """Static-analyzer entry: LeNet CNN Adam train step."""
+    from .harness import program_entry
+
+    def build():
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        _, avg_cost, acc = cnn(img, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        return avg_cost, acc
+
+    def feeds(rng):
+        return {"img": rng.rand(4, 1, 28, 28).astype("float32"),
+                "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+
+    return program_entry(build, feeds)

@@ -65,3 +65,25 @@ def build_ssd_infer_net(image_shape=(3, 64, 64), num_classes=5,
         locs, confs, boxes, vars_, nms_threshold=nms_threshold,
         score_threshold=score_threshold, keep_top_k=keep_top_k)
     return image, dets
+
+
+def analysis_entry():
+    """Static-analyzer entry: SSD train step with LoD ground truth (the
+    analyzer sees the bucketed flat-LoD feed layout)."""
+    import numpy as np
+    from paddle_tpu.core.lod import create_lod_tensor
+    from .harness import program_entry
+
+    def build():
+        _, _, _, loss = build_ssd_train_net(image_shape=(3, 64, 64))
+        return (loss,)
+
+    def feeds(rng):
+        gt = np.array([[0.1, 0.1, 0.5, 0.5], [0.4, 0.4, 0.9, 0.9],
+                       [0.2, 0.2, 0.6, 0.8]], np.float32)
+        lab = np.array([[1], [2], [3]], np.int64)
+        return {"image": rng.rand(2, 3, 64, 64).astype(np.float32),
+                "gt_box": create_lod_tensor(gt, [[2, 1]]),
+                "gt_label": create_lod_tensor(lab, [[2, 1]])}
+
+    return program_entry(build, feeds)

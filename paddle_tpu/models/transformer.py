@@ -325,10 +325,14 @@ def transformer_lm_parallel(vocab_size=4096, max_len=256, n_layer=4,
         if num_experts > 0:
             # M = pp (not gpipe's 2*pp default): each microbatch must
             # still split into dp*ep token groups, and the smaller M
-            # keeps that feasible at parity-test batch sizes
+            # keeps that feasible at parity-test batch sizes. dp
+            # resolves through the mesh/device count (effective_dp) so
+            # a dp=None strategy bakes the SAME dp*ep granularity the
+            # mesh will have, instead of tripping _pipeline_stack's
+            # gate_groups validation with a misleading mismatch error.
             kwargs.update(
                 num_experts=num_experts,
-                moe_gate_groups=(st.dp or 1) * st.ep,
+                moe_gate_groups=st.effective_dp() * st.ep,
                 num_microbatches=st.pp)
         x = layers.pipelined_decoder_stack(
             x, n_layer, n_head, d_inner,
@@ -402,6 +406,43 @@ def _parallel_decoder_layer(x, n_head, d_key, d_value, d_model, d_inner,
         f = named_fc(h, d_model, "ffn2", ("tp", None))
     return layers.layer_norm(layers.elementwise_add(x, f),
                              begin_norm_axis=len(x.shape) - 1)
+
+
+def analysis_entry():
+    """Static-analyzer entry: flagship decoder-only LM, SGD train step
+    (the same tiny config the driver's entry() compiles)."""
+    from .harness import program_entry
+    vocab, max_len = 256, 32
+
+    def build():
+        avg_cost, _ = transformer_lm(vocab_size=vocab, max_len=max_len,
+                                     n_layer=2, n_head=4, d_model=64,
+                                     d_inner=128)
+        fluid.optimizer.SGD(learning_rate=1e-3).minimize(avg_cost)
+        return (avg_cost,)
+
+    def feeds(rng):
+        return make_lm_batch(rng, 4, max_len, vocab)
+
+    return program_entry(build, feeds)
+
+
+def analysis_entry_moe():
+    """Static-analyzer entry: MoE LM (sparse_moe FFN, dense fallback
+    routing on one device) — keeps the expert path lint-covered."""
+    from .harness import program_entry
+    vocab, max_len = 256, 32
+
+    def build():
+        avg_cost, _ = transformer_lm_parallel(
+            vocab_size=vocab, max_len=max_len, n_layer=2, n_head=4,
+            d_model=64, d_inner=128, num_experts=2)
+        return (avg_cost,)
+
+    def feeds(rng):
+        return make_lm_batch(rng, 4, max_len, vocab)
+
+    return program_entry(build, feeds)
 
 
 def make_lm_batch(rng, batch, max_len, vocab_size):

@@ -340,3 +340,34 @@ class TransformerLMInfer(TransformerInfer):
         return decoding.greedy_search(self._step_logits, state,
                                       self.bos_id, self.end_id, max_out,
                                       batch)
+
+
+def analysis_entry_infer():
+    """Static-analyzer entry: bf16 KV-cached greedy decode — the
+    serving graph whose precision invariants (bf16 weights/caches, f32
+    softmax + LN stats + log-probs) the dtype-promotion rule verifies
+    statically. Params are passed as an argument pytree (not closed
+    over) so the recompile-hazard rule sees the real serving
+    signature."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        from .transformer import transformer_lm
+        transformer_lm(vocab_size=64, max_len=16, n_layer=2, n_head=2,
+                       d_model=32, d_inner=64)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        infer = TransformerLMInfer(main, scope, n_layer=2, n_head=2,
+                                   d_model=32, max_len=16,
+                                   dtype=jnp.bfloat16)
+    pnames = ("word_emb", "pos_emb", "layers", "w_out")
+    params = {n: getattr(infer, n) for n in pnames}
+
+    def fn(params):
+        for n in pnames:
+            setattr(infer, n, params[n])
+        return infer.generate(2, max_out_len=8)
+
+    return fn, (params,)

@@ -36,3 +36,19 @@ def build_train_net(image_shape=(3, 32, 32), num_classes=10,
     acc = fluid.layers.accuracy(predict, label)
     fluid.optimizer.Adam(learning_rate=learning_rate).minimize(avg_cost)
     return image, label, avg_cost, acc
+
+
+def analysis_entry():
+    """Static-analyzer entry: VGG-16 Adam train step (with dropout, so
+    the traced step exercises the RNG path)."""
+    from .harness import program_entry
+
+    def build():
+        _, _, avg_cost, acc = build_train_net(image_shape=(3, 32, 32))
+        return avg_cost, acc
+
+    def feeds(rng):
+        return {"data": rng.rand(2, 3, 32, 32).astype("float32"),
+                "label": rng.randint(0, 10, (2, 1)).astype("int64")}
+
+    return program_entry(build, feeds)

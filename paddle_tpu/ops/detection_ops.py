@@ -361,10 +361,13 @@ def _detection_map(ctx, op):
 
     class_num = int(op.attr("class_num", 0) or 0)
     if class_num > 0:
-        # true mAP (detection_map_op.h): per-class AP, averaged over the
-        # classes that have (non-difficult) ground truth. vmapped over
-        # the class axis so the trace stays one AP pipeline regardless
-        # of class count.
+        # true mAP (detection_map_op.h GetMAP): per-class AP, averaged
+        # over the classes that have (non-difficult) ground truth AND at
+        # least one counted detection — the reference `continue`s past a
+        # label whose true_pos/false_pos maps are empty, so a GT-but-
+        # undetected class is skipped entirely rather than averaged in
+        # as AP=0. vmapped over the class axis so the trace stays one AP
+        # pipeline regardless of class count.
         background = int(op.attr("background_label", 0))
         cls_ids = jnp.asarray([c for c in range(class_num)
                                if c != background], jnp.float32)
@@ -373,7 +376,8 @@ def _detection_map(ctx, op):
             (gt_cls[None, :] == cls_ids[:, None]) & ~difficult[None, :],
             axis=1)                                            # [C']
         ap_c = jax.vmap(_ap_over)(masks, gt_counts)
-        has = (gt_counts > 0).astype(jnp.float32)
+        det_present = jnp.any(masks & counted[None, :], axis=1)
+        has = ((gt_counts > 0) & det_present).astype(jnp.float32)
         ap = jnp.sum(ap_c * has) / jnp.maximum(jnp.sum(has), 1.0)
     else:
         # class_num unknown: CLASS-POOLED AP — one ranked list across

@@ -31,6 +31,35 @@ import jax.numpy as jnp
 _LANES = 128
 
 
+def matmul_flops(m, k, n):
+    """FLOPs of an [M,K] @ [K,N] matmul (multiply-accumulate = 2 ops).
+    Shared between this kernel's perf accounting and the static cost
+    model (paddle_tpu.analysis.cost)."""
+    return 2.0 * float(m) * float(k) * float(n)
+
+
+def dot_general_flops(lhs_shape, rhs_shape, dimension_numbers):
+    """FLOPs of a lax.dot_general from its shapes + dimension_numbers —
+    the per-eqn cost the jaxpr analyzer rolls up. Batch dims multiply,
+    contracting dims form K, the rest form M / N."""
+    (lc, rc), (lb, rb) = dimension_numbers
+    batch = 1.0
+    for i in lb:
+        batch *= lhs_shape[i]
+    k = 1.0
+    for i in lc:
+        k *= lhs_shape[i]
+    m = 1.0
+    for i in range(len(lhs_shape)):
+        if i not in lb and i not in lc:
+            m *= lhs_shape[i]
+    n = 1.0
+    for i in range(len(rhs_shape)):
+        if i not in rb and i not in rc:
+            n *= rhs_shape[i]
+    return batch * matmul_flops(m, k, n)
+
+
 def _dense_matmul_stats(x, w, c):
     y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)

@@ -92,11 +92,24 @@ class DistributedStrategy:
         self.pp_schedule = pp_schedule
         self.pp_virtual_stages = pp_virtual_stages
 
+    def effective_dp(self, devices=None):
+        """The dp size build_mesh will actually use: explicit dp wins;
+        dp=None divides the device pool by the fixed axes. Model
+        builders that bake dp-derived STATIC attrs (e.g. the MoE
+        moe_gate_groups = dp*ep routing granularity) must resolve dp
+        through this, not ``strategy.dp or 1`` — otherwise a dp=None
+        strategy bakes groups for dp=1 while the mesh resolves dp>1 and
+        the pipeline_stack validation rejects the mismatch."""
+        if self.dp:
+            return self.dp
+        total = len(devices) if devices is not None else \
+            jax.device_count()
+        fixed = self.tp * self.pp * self.sp * self.ep
+        return max(1, total // fixed)
+
     def build_mesh(self, devices=None):
         devices = list(devices if devices is not None else jax.devices())
-        total = len(devices)
-        fixed = self.tp * self.pp * self.sp * self.ep
-        dp = self.dp if self.dp else max(1, total // fixed)
+        dp = self.effective_dp(devices)
         sizes = {}
         for name, size in (("dp", dp), ("pp", self.pp), ("sp", self.sp),
                            ("ep", self.ep), ("tp", self.tp)):

@@ -1,0 +1,29 @@
+"""CI gate (tier-1): the static analyzer runs over the whole model zoo
+on the CPU backend and every shipped model must be free of
+error-severity diagnostics. A PR that leaks fp16 into a serving path,
+breaks the bf16 softmax/LN f32-stats contract, or wires a model so a
+parameter goes unused at error level fails here — no TPU time needed.
+
+Equivalent CLI: ``JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --all``
+"""
+
+import pytest
+
+from paddle_tpu import analysis
+
+
+@pytest.mark.parametrize("model", analysis.zoo_names())
+def test_zoo_model_is_error_free(model):
+    report = analysis.analyze_model(model)
+    errors = report.by_severity(analysis.ERROR)
+    assert not errors, "\n" + report.render_text()
+
+
+def test_every_shipped_rule_ran_against_the_zoo():
+    """All six built-in rules must exist and be enabled by default —
+    a rule silently dropped from the registry would turn the gate into
+    a no-op for its failure class."""
+    names = {cls.name for cls in
+             (r.__class__ for r in analysis.default_rules())}
+    assert {"dtype-promotion", "recompile-hazard", "sharding-transfer",
+            "numerical-risk", "dead-code", "cost-model"} <= names

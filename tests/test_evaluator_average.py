@@ -153,6 +153,27 @@ def test_detection_map_per_class_average():
         np.testing.assert_allclose(np.asarray(b), [5.0 / 6], rtol=1e-5)
 
 
+def test_detection_map_skips_undetected_classes():
+    """CalcMAP parity (detection_map_op.h GetMAP): a class with ground
+    truth but ZERO detections has empty true_pos/false_pos maps and the
+    reference `continue`s past it — it must not enter the mAP
+    denominator as AP=0. One perfect TP for class 1 + undetected class
+    2 GT -> mAP = AP(c1) = 1.0 (not 0.5)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        det = fluid.layers.data("det", [6])
+        gt = fluid.layers.data("gt", [5])
+        m = fluid.layers.detection_map(det, gt, class_num=3,
+                                       ap_version="integral")
+        exe = fluid.Executor(fluid.CPUPlace())
+        dv = np.array([[1, 0.9, 10, 10, 20, 20]], np.float32)
+        gv = np.array([[1, 10, 10, 20, 20],
+                       [2, 50, 50, 60, 60]], np.float32)
+        mv, = exe.run(main, feed={"det": dv, "gt": gv}, fetch_list=[m])
+        np.testing.assert_allclose(np.asarray(mv), [1.0], rtol=1e-5)
+
+
 def test_detection_map_evaluator_requires_difficult_input():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
