@@ -148,6 +148,48 @@ def main():
             heads=16)[0])
         lstm_s.append(lstm_repeat())
 
+    def monitor_probe():
+        """One short MONITORED window (benchmarks/mnist.py shrunk):
+        paddle_tpu.monitor armed with flight recorder + cost model, the
+        summary stamped into the bench JSON. Kept separate from the
+        headline timing windows because the monitor syncs every step
+        for honest latency — on the sandbox tunnel that per-step sync
+        costs ~90 ms and would corrupt the throughput protocol."""
+        from paddle_tpu import monitor as mon
+        _fresh()
+        log = "/tmp/ptpu_bench_monitor.jsonl"
+        try:
+            os.remove(log)
+        except OSError:
+            pass
+        # monitor.session(): respects an env-armed ambient config and
+        # reports the PROBE's own counts as deltas, so the stamp never
+        # aggregates the headline windows' steps
+        try:
+            with mon.session(log_path=log) as sess:
+                _run(["--batch_size", "128", "--iterations", "10",
+                      "--skip_batch_num", "2", "--device", "TPU"])
+                import mnist as mmod
+                importlib.reload(mmod).main()
+        except Exception as e:
+            print("monitor probe failed: %s" % e, file=sys.stderr)
+            return None
+        s = sess.summary()
+        probe = {
+            "steps": s["steps"],
+            "p50_ms": round(1000 * s["p50_s"], 3) if s["p50_s"] else None,
+            "p95_ms": round(1000 * s["p95_s"], 3) if s["p95_s"] else None,
+            "recompiles": s["recompiles"],
+            "tokens_per_sec": round(s["tokens_per_sec"], 1)
+            if s["tokens_per_sec"] else None,
+            "mfu_pct": round(100 * s["mfu"], 2) if s["mfu"] else None,
+            "log": log,
+        }
+        print("monitor probe: %s" % probe, file=sys.stderr)
+        return probe
+
+    monitor_summary = monitor_probe()
+
     import statistics
 
     def agg(samples):
@@ -198,6 +240,10 @@ def main():
         out["lstm_vs_baseline"] = round(184.0 / lstm_ms, 2)
         out["lstm_spread_pct"] = lstm_spread
         out["lstm_samples"] = lstm_samples
+    if monitor_summary is not None:
+        # runtime-telemetry stamp (paddle_tpu.monitor): per-step p50/p95,
+        # recompile count and cost-model MFU of the monitored probe
+        out["monitor"] = monitor_summary
     print(json.dumps(out))
 
 

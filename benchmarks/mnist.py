@@ -22,6 +22,9 @@ def parse_args():
     p.add_argument("--pass_num", type=int, default=1)
     p.add_argument("--device", type=str, default="TPU",
                    choices=["CPU", "TPU", "GPU"])
+    p.add_argument("--monitor_log", type=str, default="",
+                   help="arm paddle_tpu.monitor with this flight-recorder"
+                        " JSONL path and print the telemetry summary")
     return p.parse_args()
 
 
@@ -39,26 +42,40 @@ def build():
 
 def main():
     args = parse_args()
-    img, label, avg_cost = build()
-    place = fluid.CPUPlace() if args.device == "CPU" else fluid.TPUPlace(0)
-    exe = fluid.Executor(place)
-    exe.run(fluid.default_startup_program())
+    import contextlib
+    with contextlib.ExitStack() as stack:
+        mon_sess = None
+        if getattr(args, "monitor_log", ""):
+            from paddle_tpu import monitor
+            # session(): reuses an env-armed ambient config untouched,
+            # arms a fresh recorder only when the monitor is off, and
+            # reports THIS run's counts as deltas either way; the
+            # ExitStack disarms it even when a step raises
+            mon_sess = stack.enter_context(
+                monitor.session(log_path=args.monitor_log))
+            stack.callback(
+                lambda: print("monitor: %s" % mon_sess.summary()))
+        img, label, avg_cost = build()
+        place = fluid.CPUPlace() if args.device == "CPU" \
+            else fluid.TPUPlace(0)
+        exe = fluid.Executor(place)
+        exe.run(fluid.default_startup_program())
 
-    rng = np.random.RandomState(0)
-    xs = rng.rand(args.batch_size, 784).astype(np.float32)
-    ys = rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(args.batch_size, 784).astype(np.float32)
+        ys = rng.randint(0, 10, (args.batch_size, 1)).astype(np.int64)
 
-    times = []
-    for i in range(args.iterations + args.skip_batch_num):
-        t0 = time.time()
-        loss, = exe.run(feed={"img": xs, "label": ys},
-                        fetch_list=[avg_cost])
-        _ = float(np.asarray(loss))   # sync
-        if i >= args.skip_batch_num:
-            times.append(time.time() - t0)
-    ips = args.batch_size / np.mean(times)
-    print("avg %.4f ms/batch, %.1f imgs/sec" %
-          (1000 * np.mean(times), ips))
+        times = []
+        for i in range(args.iterations + args.skip_batch_num):
+            t0 = time.time()
+            loss, = exe.run(feed={"img": xs, "label": ys},
+                            fetch_list=[avg_cost])
+            _ = float(np.asarray(loss))   # sync
+            if i >= args.skip_batch_num:
+                times.append(time.time() - t0)
+        ips = args.batch_size / np.mean(times)
+        print("avg %.4f ms/batch, %.1f imgs/sec" %
+              (1000 * np.mean(times), ips))
     return ips
 
 

@@ -64,6 +64,10 @@ from .transpiler import (  # noqa: F401
 )
 from . import amp  # noqa: F401
 from . import flags  # noqa: F401
+from . import monitor  # noqa: F401
+
+# PADDLE_TPU_MONITOR=1 arms runtime telemetry for the whole process
+monitor.maybe_enable_from_flags()
 from . import distributed  # noqa: F401
 from .distributed import DistributeTranspiler  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401

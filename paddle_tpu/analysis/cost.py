@@ -112,6 +112,16 @@ class CostTable:
         return b * w
 
 
+def step_costs(fn, example_args):
+    """(total_flops, total_bytes) of one call of ``fn(*example_args)``
+    from the static cost model — abstract trace only, nothing executes.
+    This is the bridge paddle_tpu.monitor uses to price a compiled step
+    once per compile and derive per-step MFU from wall time."""
+    from .engine import Analysis
+    table = CostTable(Analysis(fn, example_args, name="step"))
+    return table.total_flops, table.total_bytes
+
+
 def fmt_flops(f):
     for unit, scale in (("TFLOP", 1e12), ("GFLOP", 1e9), ("MFLOP", 1e6),
                         ("kFLOP", 1e3)):

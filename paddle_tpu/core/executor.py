@@ -252,6 +252,8 @@ class Executor:
                fetch_names, state_keys, amp_enabled(), check_nan,
                get_flag("fuse_conv_bn"),
                tuple(sorted(static_info.items())))
+        from .. import monitor as _mon
+        mon_on = _mon.enabled()
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             fn = self._build(program, tuple(sorted(feed_arrays)), fetch_names,
@@ -259,12 +261,37 @@ class Executor:
             entry = jax.jit(fn, donate_argnums=(0,))
             if use_program_cache:
                 self._cache[key] = entry
+            if mon_on and use_program_cache:
+                # price the step with the static cost model (traced once
+                # here, at compile time) so per-step MFU is derivable;
+                # classify the compile against this program's history.
+                # use_program_cache=False is a DELIBERATE cache bypass —
+                # counting each of its runs as a recompile would report
+                # key churn that isn't there
+                rng0 = jax.random.key(0)
+                _mon.on_compile(
+                    program, key, key[2],
+                    cost_fn=lambda: _step_costs_safe(
+                        fn, dict(state), dict(feed_arrays), rng0),
+                    tokens=_mon.tokens_in_feeds(feed_arrays))
+        elif mon_on:
+            _mon.on_cache_hit()
 
         rng_key = jax.random.key(
             np.uint32(program.random_seed * 1000003 + self._rng_counter))
         self._rng_counter += 1
 
+        import time as _time
         from .. import profiler as _prof
+        t0 = _time.perf_counter() if mon_on else 0.0
+        if mon_on:
+            # monitor_sync_every=N amortization: sync once per N steps
+            # so async dispatch pipelines keep pipelining; the synced
+            # step reports the window-average as per-step latency
+            timer = _mon.step_timer(self)
+            # with the profiler on every step blocks anyway — keep the
+            # already-paid exact latencies instead of window-averaging
+            do_sync = timer.begin(t0) or _prof._enabled
         with jax.default_device(self.place.jax_device()):
             if _prof._enabled:
                 # step-level event; sync INSIDE the event so the row
@@ -278,6 +305,20 @@ class Executor:
             else:
                 fetches, new_state, guards, fetch_lods = entry(
                     state, feed_arrays, rng_key)
+                if mon_on and do_sync:
+                    # sync inside the span: the histogram must record
+                    # real step latency, not async dispatch time
+                    jax.block_until_ready(fetches)
+        if mon_on:
+            now = _time.perf_counter()
+            fb = _mon.feed_nbytes(feed_arrays)
+            tk = _mon.tokens_in_feeds(feed_arrays)
+            if do_sync:
+                _mon.on_step(key, timer.end_synced(now, t0),
+                             feed_bytes=fb, tokens=tk)
+            else:
+                _mon.on_step(key, now - t0, feed_bytes=fb, tokens=tk,
+                             synced=False)
         fetches = self._trim_fetches(fetch_names, fetches, fetch_lods)
 
         # Commit updated persistable state back to the scope.
@@ -309,6 +350,10 @@ class Executor:
         interpretation when a host op feeds the forward of a grad marker
         (autodiff must trace through it — e.g. the sparse prefetch path)
         or when PADDLE_TPU_SEGMENT_COMPILE=0."""
+        import time as _time
+        from .. import monitor as _mon
+        mon_on = _mon.enabled()
+        t0 = _time.perf_counter() if mon_on else 0.0
         block = program.global_block()
         ops = list(block.ops)
         persistable = {v.name for v in block.vars.values() if v.persistable}
@@ -400,6 +445,13 @@ class Executor:
         fetch_lods = {n: env[n + "@LOD"] for n in fetch_names
                       if env.get(n + "@LOD") is not None}
         fetches = self._trim_fetches(fetch_names, fetches, fetch_lods)
+        if mon_on:
+            # host-op (distributed trainer) step: no cached-step key, so
+            # no MFU — latency/throughput telemetry still lands
+            _mon.on_step(None, _time.perf_counter() - t0,
+                         feed_bytes=_mon.feed_nbytes(feed_arrays),
+                         tokens=_mon.tokens_in_feeds(feed_arrays),
+                         executor="eager")
         if return_numpy:
             return [as_numpy(v) for v in fetches]
         return fetches
@@ -983,6 +1035,8 @@ class Executor:
         for n, v in zip(names, values):
             arr = np.asarray(v)
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                from .. import monitor as _mon
+                _mon.on_nan_trip("fetch", detail=n)
                 raise FloatingPointError(
                     "NaN/Inf detected in fetched var %r" % n)
 
@@ -996,9 +1050,18 @@ class Executor:
         if bad:
             k = min(bad, key=lambda s: int(s[len(_NANGUARD):].split("|")[0]))
             _, op_type, var = k[len(_NANGUARD):].split("|", 2)
+            from .. import monitor as _mon
+            _mon.on_nan_trip("guard", detail="%s/%s" % (op_type, var))
             raise FloatingPointError(
                 "NaN/Inf detected in output %r of op %r "
                 "(PADDLE_TPU_CHECK_NAN_INF)" % (var, op_type))
+
+
+def _step_costs_safe(fn, state, feeds, rng_key):
+    """Static (flops, bytes) of one step for the monitor's MFU gauge —
+    abstract trace only (analysis.cost.step_costs)."""
+    from ..analysis.cost import step_costs
+    return step_costs(fn, (state, feeds, rng_key))
 
 
 def _lower_op_eager(ctx, op):

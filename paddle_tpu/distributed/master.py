@@ -22,8 +22,13 @@ import threading
 import time
 
 from .rpc import _send_msg, _recv_msg
+from ..monitor import metrics as _metrics
 
 __all__ = ["TaskQueue", "MasterServer", "MasterClient"]
+
+_REG = _metrics.registry()
+_TASKS = _REG.counter("ptpu_master_tasks_total",
+                      "elastic-master task transitions", ("state",))
 
 
 class TaskQueue:
@@ -61,6 +66,7 @@ class TaskQueue:
             ent = self.pending.pop(int(task_id), None)
             if ent is not None:
                 self.done.append(ent["task"])
+                _TASKS.inc(state="done")
                 self._snapshot()
                 return True
             return False
@@ -70,6 +76,7 @@ class TaskQueue:
             ent = self.pending.pop(int(task_id), None)
             if ent is not None:
                 self._fail_or_retry(ent["task"])
+                _TASKS.inc(state="failed")
                 self._snapshot()
                 return True
             return False
@@ -100,6 +107,7 @@ class TaskQueue:
         for tid in expired:
             ent = self.pending.pop(tid)
             self._fail_or_retry(ent["task"])
+            _TASKS.inc(state="lease_expired")
         if expired:
             self._snapshot()
 

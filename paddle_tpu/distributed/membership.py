@@ -22,9 +22,20 @@ import time
 import uuid
 
 from .rpc import _send_msg, _recv_msg
+from ..monitor import metrics as _metrics
 
 __all__ = ["KVServer", "KVClient", "register_pserver", "wait_for_pservers",
            "TrainerLease"]
+
+_REG = _metrics.registry()
+_HEARTBEATS = _REG.counter("ptpu_lease_heartbeats_total",
+                           "TTL-lease keepalive beats sent")
+_LEASE_RECLAIMS = _REG.counter(
+    "ptpu_lease_reclaims_total",
+    "expired leases re-claimed by their holder (stall recovered)")
+_LEASE_LOST = _REG.counter(
+    "ptpu_lease_lost_total",
+    "leases lost to a usurper (holder must re-register)")
 
 
 class KVServer:
@@ -253,16 +264,19 @@ class _Lease:
     def _run(self):
         while not self._stop.wait(self.ttl / 3.0):
             try:
+                _HEARTBEATS.inc()
                 if self.kv.lease_keepalive(self.key, self.ttl,
                                            expect=self.value):
                     continue
                 # expired: try to reclaim our slot atomically
                 if self.kv.cas(self.key, None, self.value, ttl=self.ttl):
+                    _LEASE_RECLAIMS.inc()
                     continue
                 cur = self.kv.get(self.key)
                 if cur == self.value:       # raced with our own reclaim
                     continue
                 self.lost = True            # someone else owns it now
+                _LEASE_LOST.inc()
                 return
             except (ConnectionError, OSError):
                 return

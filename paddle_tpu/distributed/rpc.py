@@ -24,11 +24,32 @@ import uuid
 import numpy as np
 
 from ..core.selected_rows import SelectedRows
+from ..monitor import metrics as _metrics
 
 __all__ = ["VariableServer", "RPCClient", "serialize_var",
            "deserialize_var"]
 
 _MAGIC = b"PTV1"
+
+# distributed-runtime telemetry (paddle_tpu.monitor registry; a counter
+# bump is sub-microsecond next to a socket round-trip, so these record
+# unconditionally — the watchdog/flight-recorder read them on stalls)
+_REG = _metrics.registry()
+_RPC_REQS = _REG.counter("ptpu_rpc_requests_total",
+                         "pserver requests handled", ("op",))
+_RPC_BYTES = _REG.counter("ptpu_rpc_payload_bytes_total",
+                          "pserver payload bytes received")
+_PS_ROUNDS = _REG.counter("ptpu_ps_rounds_total",
+                          "sync-SGD rounds applied by this pserver")
+_PS_EVICTIONS = _REG.counter(
+    "ptpu_ps_incarnation_evictions_total",
+    "pending grads/barrier slots evicted from dead trainer incarnations")
+_PS_STALE = _REG.counter(
+    "ptpu_ps_stale_rejections_total",
+    "messages rejected (STLE) as stale-incarnation stragglers")
+_RPC_CHUNK_PUSHES = _REG.counter(
+    "ptpu_rpc_chunk_pushes_total",
+    "chunk-parallel large-value pushes (client side)")
 
 
 def _serialize_parts(value):
@@ -357,6 +378,8 @@ class VariableServer:
         return entry["buf"]
 
     def _dispatch(self, sock, op, name, payload):
+        _RPC_REQS.inc(op=op)
+        _RPC_BYTES.inc(len(payload))
         if op in ("SEND", "PUT"):
             payload = self._resolve_chunked(payload)
         if op == "SEND":
@@ -376,6 +399,7 @@ class VariableServer:
                     stale = (self._stale_epoch(pref)
                              if pref is not None else None)
                     if stale is not None:
+                        _PS_STALE.inc()
                         _send_msg(sock, "STLE", name, json.dumps(
                             {"max_epoch": stale}).encode())
                         return
@@ -484,6 +508,7 @@ class VariableServer:
         for slot in self.grads.values():
             for k in [k for k in slot if stale(k)]:
                 del slot[k]
+                _PS_EVICTIONS.inc()
         dead_barrs = {t for t in self._barr_seen if stale(t)}
         if dead_barrs:
             self._barr_seen -= dead_barrs
@@ -517,6 +542,7 @@ class VariableServer:
         with self._round_cv:
             stale = self._stale_epoch(pref) if pref is not None else None
             if stale is not None:
+                _PS_STALE.inc()
                 _send_msg(sock, "STLE", tag or "", json.dumps(
                     {"max_epoch": stale}).encode())
                 return
@@ -555,6 +581,7 @@ class VariableServer:
                 self._barrier_count = 0
                 self._barr_seen = set()
                 self._round += 1
+                _PS_ROUNDS.inc()
                 self._round_cv.notify_all()
             else:
                 while (self._round == my_round
@@ -673,6 +700,7 @@ class RPCClient:
             _send_msg(self._sock, op, wire, parts)
             return self._expect_ok()
         n = _CHUNK_STREAMS
+        _RPC_CHUNK_PUSHES.inc()
         tid = uuid.uuid4().hex[:12]
         bounds = [total * i // n for i in range(n + 1)]
         socks = self._streams(n)

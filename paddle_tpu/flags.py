@@ -17,6 +17,9 @@ Registered flags:
   debug_nans      bool  jax_debug_nans — XLA-level NaN tracer (heavier
                         than check_nan_inf; locates the primitive)
   data_home       str   dataset cache directory
+  monitor*        —     paddle_tpu.monitor runtime telemetry knobs (arm
+                        at import, flight-recorder path, stall watchdog,
+                        console reporter, MFU peak/cost-model)
 
 Distributed bootstrap envs (read by distributed.launch, not here):
   PADDLE_COORDINATOR, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID.
@@ -77,6 +80,35 @@ _register("gather_sharded_fetches", bool, False,
           "parallel_executor.cc:190-197). Default OFF: the gather "
           "crosses DCN on every fetch, so the default stays the loud "
           "NotImplementedError telling you to fetch replicated values")
+_register("monitor", bool, False,
+          "arm paddle_tpu.monitor at import: step/compile telemetry into "
+          "the process-wide metrics registry (near-zero overhead; see "
+          "monitor_log / monitor_stall_timeout for the recorder/watchdog)")
+_register("monitor_log", str, "",
+          "flight-recorder JSONL path (with the monitor flag on); empty "
+          "= metrics only, no event log")
+_register("monitor_stall_timeout", float, 0.0,
+          "seconds without a completed step/compile before the monitor "
+          "watchdog dumps all thread stacks + a metrics snapshot "
+          "(0 = watchdog off)")
+_register("monitor_report_interval", float, 0.0,
+          "seconds between one-line monitor console reports to stderr "
+          "(0 = no reporter thread)")
+_register("monitor_peak_flops", float, 0.0,
+          "device peak FLOP/s for the MFU gauge (0 = auto-detect by TPU "
+          "device kind; stays unset on CPU, disabling the gauge)")
+_register("monitor_sync_every", int, 1,
+          "sync (block_until_ready) every Nth monitored step. 1 = every "
+          "step: exact latency, but serializes JAX async dispatch — fine "
+          "on CPU and for debugging. N>1: async TPU pipelines keep "
+          "dispatch pipelining; the monitor syncs once per N steps and "
+          "reports the window-average as that step's latency "
+          "(intermediate steps log dispatch time, flagged synced=false, "
+          "and are excluded from the latency histogram/MFU)")
+_register("monitor_cost_model", bool, True,
+          "price each compiled step with the paddle_tpu.analysis static "
+          "cost model (one extra trace per COMPILE, nothing per step) so "
+          "the monitor can derive MFU")
 _register("fuse_conv_bn", bool, False,
           "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
           "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "

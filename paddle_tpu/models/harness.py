@@ -7,6 +7,11 @@ compile — so paddle_tpu.analysis lints the real training/inference
 graph, not a simplified stand-in. Each models/* module wraps this in a
 small ``analysis_entry()`` so the zoo registry (models/__init__.ZOO)
 can enumerate every workload.
+
+``monitored_run(build_fn, feed_fn, steps)`` is the RUNTIME sibling:
+execute a zoo entry for a few real steps under paddle_tpu.monitor and
+return the telemetry summary — the one-call health check (step p50,
+recompiles, cost-model MFU) for any model the zoo can name.
 """
 
 import numpy as np
@@ -39,3 +44,32 @@ def program_entry(build_fn, feed_fn, seed=0):
                     tuple(v.name for v in fetch_vars),
                     tuple(sorted(state)), static_info=static_info)
     return fn, (state, feed_arrays, jax.random.key(seed))
+
+
+def monitored_run(build_fn, feed_fn, steps=3, seed=0, log_path=None,
+                  **enable_kwargs):
+    """Run a zoo entry for ``steps`` real Executor steps under
+    paddle_tpu.monitor; returns a ``monitor.summary()``-shaped dict
+    whose COUNT fields (steps/compiles/recompiles/cache_hits/
+    feed_bytes) are deltas for THIS run; latency percentiles and the
+    MFU/tokens-s gauges reflect the ambient session (last values).
+    Programs/scope are fresh. The process-wide registry is never reset
+    (counters are monotonic by contract); if the monitor is ALREADY
+    armed (e.g. PADDLE_TPU_MONITOR=1) the ambient session is reused
+    untouched, otherwise one is armed for the call and disarmed after."""
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+
+    with monitor.session(log_path=log_path, **enable_kwargs) as sess:
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            fetch_vars = build_fn()
+            if not isinstance(fetch_vars, (tuple, list)):
+                fetch_vars = (fetch_vars,)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(seed)
+            for _ in range(steps):
+                exe.run(main, feed=feed_fn(rng), fetch_list=fetch_vars)
+    return sess.summary()

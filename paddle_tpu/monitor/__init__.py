@@ -1,0 +1,30 @@
+"""paddle_tpu.monitor — always-available runtime telemetry.
+
+Three pieces (see each module's docstring):
+  metrics   thread-safe Counter/Gauge/Histogram registry + Prometheus
+            text / JSON export
+  recorder  bounded JSONL flight recorder of structured run events
+  watchdog  stall detector that dumps all thread stacks
+
+Quickstart::
+
+    from paddle_tpu import monitor
+    monitor.enable(log_path="run.jsonl", stall_timeout=300)
+    ...train...
+    print(monitor.prometheus_text())
+
+or env-driven: ``PADDLE_TPU_MONITOR=1 PADDLE_TPU_MONITOR_LOG=run.jsonl``.
+Summarize a recorded log: ``python -m paddle_tpu.monitor run.jsonl``.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, Registry,  # noqa: F401
+                      registry)
+from .recorder import FlightRecorder, read_jsonl  # noqa: F401
+from .watchdog import Watchdog, thread_stacks  # noqa: F401
+from .runtime import (  # noqa: F401
+    enable, disable, enabled, recorder, set_peak_flops,
+    set_tokens_per_step, on_compile, on_cache_hit, on_step, on_nan_trip,
+    feed_nbytes, tokens_in_feeds, sync_every, step_timer, summary,
+    session, prometheus_text, dump_metrics, maybe_enable_from_flags,
+    reset_for_tests,
+)

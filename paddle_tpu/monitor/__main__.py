@@ -1,0 +1,118 @@
+"""CLI: summarize a flight-recorder JSONL log.
+
+    python -m paddle_tpu.monitor run.jsonl [--json]
+
+Prints run metadata, step count and latency percentiles, compile /
+recompile counts (with causes), NaN trips, stalls, and the derived
+throughput figures (mean MFU, tokens/s) the runtime stamped on each
+step event. `--json` emits the same summary as one JSON object for
+scripts (bench.py consumes this shape).
+"""
+
+import argparse
+import json
+import sys
+
+from .recorder import read_jsonl
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def summarize_log(path):
+    events = read_jsonl(path)
+    steps = [e for e in events if e["ev"] == "step"]
+    compiles = [e for e in events if e["ev"] == "compile"]
+    # latency percentiles use SYNCED samples only: unsynced steps
+    # (monitor_sync_every amortization) logged dispatch time, not wall
+    dts = sorted(e["dt"] for e in steps
+                 if e.get("dt") is not None and e.get("synced", True))
+    mfus = [e["mfu"] for e in steps if e.get("mfu")]
+    tps = [e["tokens_per_sec"] for e in steps if e.get("tokens_per_sec")]
+    reasons = {}
+    for c in compiles:
+        reasons[c.get("reason", "?")] = reasons.get(
+            c.get("reason", "?"), 0) + 1
+    # device info rides a separate lazy `devices` event (run_meta is
+    # written at enable() time, before the jax backend may exist)
+    dev = next((e for e in events if e["ev"] == "devices"), {})
+    out = {
+        "path": path,
+        "events": len(events),
+        "platform": dev.get("platform"),
+        "device_kind": dev.get("device_kind"),
+        "steps": len(steps),
+        "p50_s": _percentile(dts, 0.50),
+        "p95_s": _percentile(dts, 0.95),
+        "total_step_s": sum(dts),
+        "compiles": len(compiles),
+        "compile_reasons": reasons,
+        "recompiles": sum(1 for c in compiles if c.get("recompile")),
+        "xla_compile_s": sum(e.get("seconds", 0.0) for e in events
+                             if e["ev"] == "xla_compile"),
+        "feed_bytes": sum(e.get("feed_bytes") or 0 for e in steps),
+        "mean_mfu": (sum(mfus) / len(mfus)) if mfus else None,
+        "mean_tokens_per_sec": (sum(tps) / len(tps)) if tps else None,
+        "nan_trips": sum(1 for e in events if e["ev"] == "nan_guard"),
+        "stalls": sum(1 for e in events if e["ev"] == "stall"),
+        "truncated": any(e["ev"] == "truncated" for e in events),
+    }
+    return out
+
+
+def _fmt_ms(v):
+    return "n/a" if v is None else "%.2f ms" % (1000 * v)
+
+
+def render(s):
+    lines = [
+        "flight log %s: %d events%s" % (
+            s["path"], s["events"],
+            " [TRUNCATED]" if s["truncated"] else ""),
+        "  device      %s %s" % (s.get("platform") or "?",
+                                 s.get("device_kind") or ""),
+        "  steps       %d  (p50 %s, p95 %s, total %.2f s)" % (
+            s["steps"], _fmt_ms(s["p50_s"]), _fmt_ms(s["p95_s"]),
+            s["total_step_s"]),
+        "  compiles    %d  (%s)  recompiles %d  xla wall %.2f s" % (
+            s["compiles"],
+            ", ".join("%s=%d" % kv
+                      for kv in sorted(s["compile_reasons"].items()))
+            or "-",
+            s["recompiles"], s["xla_compile_s"]),
+        "  feed bytes  %d" % s["feed_bytes"],
+    ]
+    if s["mean_mfu"] is not None:
+        lines.append("  MFU         %.1f%%" % (100 * s["mean_mfu"]))
+    if s["mean_tokens_per_sec"] is not None:
+        lines.append("  tokens/s    %.0f" % s["mean_tokens_per_sec"])
+    if s["nan_trips"]:
+        lines.append("  NaN trips   %d" % s["nan_trips"])
+    if s["stalls"]:
+        lines.append("  STALLS      %d" % s["stalls"])
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.monitor",
+        description="Summarize a paddle_tpu.monitor flight-recorder log")
+    p.add_argument("log", help="flight-recorder .jsonl path")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as one JSON object")
+    args = p.parse_args(argv)
+    s = summarize_log(args.log)
+    if args.json:
+        print(json.dumps(s))
+    else:
+        print(render(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Flight recorder: bounded, structured JSONL log of run events.
+
+The black-box half of paddle_tpu.monitor: every structured event (run
+metadata, per-step timing, compile/recompile, NaN-guard trips, stalls)
+is one JSON object per line, written synchronously under a lock (events
+are rare relative to their cost budgets: a step event per training step,
+a compile event per recompilation). Bounded: past ``max_bytes`` the
+recorder stops writing payload events and appends a single final
+``truncated`` line carrying the dropped-event count, so a runaway run
+cannot fill a disk while the log stays machine-parseable end to end.
+
+Schema (every line):
+  {"ts": <epoch seconds float>, "ev": "<type>", ...fields}
+Event types written by the runtime:
+  run_meta | devices | step | compile | xla_compile | nan_guard |
+  stall | note | truncated
+"""
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["FlightRecorder"]
+
+_DEFAULT_MAX_BYTES = 64 << 20
+
+
+class FlightRecorder:
+    def __init__(self, path, max_bytes=_DEFAULT_MAX_BYTES):
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self._dropped = 0
+        self._truncated_written = False
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # append mode: the byte budget must count what is ALREADY in the
+        # file, or every re-enable()/restart hands the same log a fresh
+        # max_bytes and the disk-bound guarantee is gone
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            pass
+        self._f = open(path, "a", buffering=1)
+
+    def record(self, ev, **fields):
+        """Append one event. Non-JSON-able field values degrade to their
+        repr — a telemetry write must never throw into the hot path."""
+        rec = {"ts": time.time(), "ev": str(ev)}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec)
+        except (TypeError, ValueError):
+            rec = {k: (v if isinstance(
+                v, (str, int, float, bool, type(None))) else repr(v))
+                for k, v in rec.items()}
+            line = json.dumps(rec)
+        # budget in ENCODED bytes (json.dumps default-escapes to ASCII,
+        # but field values may carry multibyte text; getsize() at open
+        # is bytes too, so the units must match)
+        nb = len(line.encode("utf-8", "surrogatepass")) + 1
+        with self._lock:
+            if self._f is None:
+                return False
+            if self._truncated_written:
+                # the truncated marker is FINAL: smaller events after a
+                # large overflowing one must not slip in past it, or the
+                # marker lies about where recording stopped
+                self._dropped += 1
+                return False
+            if self._bytes + nb > self.max_bytes:
+                self._dropped += 1
+                # in-band cap marker (profiler TRACE TRUNCATED parity)
+                self._truncated_written = True
+                tr = json.dumps({"ts": time.time(), "ev": "truncated",
+                                 "max_bytes": self.max_bytes})
+                self._f.write(tr + "\n")
+                self._bytes += len(tr) + 1
+                return False
+            self._f.write(line + "\n")
+            self._bytes += nb
+            return True
+
+    @property
+    def dropped(self):
+        with self._lock:
+            return self._dropped
+
+    def flush(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+
+    def close(self):
+        # no trailing note: the truncated marker (written at the first
+        # drop) is the documented FINAL line of a capped log; the
+        # in-process drop count stays readable via .dropped
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+
+def read_jsonl(path):
+    """Parse a flight-recorder log → list of event dicts. Raises
+    ValueError naming the first malformed line (schema guarantee the
+    tests pin)."""
+    events = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    "%s line %d is not valid JSON: %s" % (path, i + 1, e))
+            if not isinstance(rec, dict) or "ts" not in rec \
+                    or "ev" not in rec:
+                raise ValueError(
+                    "%s line %d missing ts/ev fields" % (path, i + 1))
+            events.append(rec)
+    return events

@@ -1,0 +1,685 @@
+"""Monitor runtime: the glue between the metrics registry, the flight
+recorder, the stall watchdog, and the executors' hot paths.
+
+`enable()` arms the subsystem; until then every executor hook is one
+boolean check (`enabled()`), so an unmonitored run pays nothing. Armed,
+each `Executor.run` / `ParallelExecutor.run` reports:
+
+  * a step-latency observation (histogram + flight-recorder `step` line),
+  * compile-cache hit/miss and RECOMPILE counters classified against the
+    executor's own cache key — a recompile names which key component
+    moved (feed signature / program version / options), the #1 silent
+    TPU throughput killer the static analyzer can only warn about,
+  * feed-upload bytes (host arrays crossing to the device),
+  * derived gauges: achieved MFU and tokens/s (static FLOPs per step
+    from the paddle_tpu.analysis cost model, priced once per compile),
+    and device live/peak memory via profiler.device_memory.
+
+XLA compile wall time is captured from jax.monitoring's duration events
+(the `/jax/.../compile...` family) — the same numbers a fleet-level
+dashboard scrapes, here landing in the local registry.
+"""
+
+import collections
+import os
+import sys
+import threading
+import time
+import weakref
+
+from . import metrics as _metrics
+from .recorder import FlightRecorder
+from .watchdog import Watchdog
+
+__all__ = [
+    "enable", "disable", "enabled", "recorder", "set_peak_flops",
+    "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
+    "summary", "session", "prometheus_text", "dump_metrics",
+]
+
+_REG = _metrics.registry()
+
+# -- metric declarations (import-time, cheap, shared) ----------------------
+STEPS = _REG.counter("ptpu_steps_total",
+                     "completed executor steps", ("executor",))
+STEP_SECONDS = _REG.histogram("ptpu_step_seconds",
+                              "wall time of one executor step",
+                              ("executor",))
+CACHE_HITS = _REG.counter("ptpu_compile_cache_hits_total",
+                          "compiled-step cache hits")
+CACHE_MISSES = _REG.counter("ptpu_compile_cache_misses_total",
+                            "compiled-step cache misses (traces+compiles)")
+COMPILES = _REG.counter("ptpu_compiles_total",
+                        "program compiles by cause", ("reason",))
+RECOMPILES = _REG.counter(
+    "ptpu_recompiles_total",
+    "compiles of a program ALREADY compiled under another key — each one "
+    "burned compile time that better feed bucketing could have saved")
+FEED_BYTES = _REG.counter("ptpu_feed_bytes_total",
+                          "host feed bytes uploaded to the device")
+NAN_TRIPS = _REG.counter("ptpu_nan_guard_trips_total",
+                         "NaN/Inf guard trips", ("where",))
+XLA_COMPILE_SECONDS = _REG.histogram(
+    "ptpu_xla_compile_seconds",
+    "XLA compile wall time (jax.monitoring duration events)", ("what",))
+HBM_LIVE = _REG.gauge("ptpu_device_bytes_in_use", "device bytes live")
+HBM_PEAK = _REG.gauge("ptpu_device_bytes_peak", "device bytes peak")
+MFU = _REG.gauge("ptpu_mfu",
+                 "achieved fraction of peak FLOP/s for the last step")
+TOKENS_PER_SEC = _REG.gauge("ptpu_tokens_per_sec",
+                            "tokens processed per second, last step")
+STEP_FLOPS = _REG.gauge("ptpu_step_flops",
+                        "static cost-model FLOPs of the cached step")
+STALLS = _REG.counter("ptpu_stalls_total", "watchdog stall reports")
+
+
+# bound on remembered per-compile cost entries: each key tuple pins its
+# Program, so an unbounded map would leak graphs in a serving loop that
+# compiles per-request programs (LRU eviction keeps the hot steps priced)
+_COSTS_CAP = 512
+
+
+class _State:
+    on = False
+    rec = None            # FlightRecorder | None
+    dog = None            # Watchdog | None
+    reporter = None       # (thread, stop_event) | None
+    peak_flops = None     # float | None (None = auto-detect)
+    listener_registered = False
+    lock = threading.Lock()
+    # per-program compile history {"versions", "sigs", "count"} — WEAK
+    # keys: a discarded Program must not stay pinned (and a reused id
+    # must not inherit a dead program's history)
+    programs = weakref.WeakKeyDictionary()
+    # cache key (by value) -> {"flops", "bytes", "tokens", "devices"}
+    costs = collections.OrderedDict()
+    tokens_override = None
+    devices_recorded = False
+    platform = None       # cached backend platform (cannot change)
+    t_enable = None
+    step_serial = 0
+
+
+_S = _State()
+
+
+def enabled():
+    return _S.on
+
+
+def recorder():
+    return _S.rec
+
+
+def set_peak_flops(value):
+    """Override the device peak FLOP/s used for the MFU gauge (e.g.
+    197e12 for a v5e chip in bf16)."""
+    _S.peak_flops = float(value) if value else None
+
+
+def set_tokens_per_step(n):
+    """Pin tokens-per-step for the tokens/s gauge, overriding the
+    integer-feed-size heuristic (call with None to restore it)."""
+    _S.tokens_override = int(n) if n else None
+
+
+def _auto_peak_flops():
+    from .. import flags
+    try:
+        v = float(flags.get_flag("monitor_peak_flops"))
+    except KeyError:
+        v = 0.0
+    if v > 0:
+        return v
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        if dev.platform == "tpu":
+            # single-chip bf16 peak by generation (dense); unknown kinds
+            # fall back to the v5e figure BASELINE.json benches against
+            kind = getattr(dev, "device_kind", "").lower()
+            table = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+                     "v5p": 459e12, "v6": 918e12}
+            for k, f in table.items():
+                if k in kind:
+                    return f
+            return 197e12
+    except Exception:
+        pass
+    return None
+
+
+def enable(log_path=None, stall_timeout=None, report_interval=None,
+           peak_flops=None, max_log_bytes=None):
+    """Arm the monitor. Idempotent-ish: calling again replaces the
+    flight recorder / watchdog configuration.
+
+    log_path:        flight-recorder JSONL path (None = no recorder)
+    stall_timeout:   seconds without a completed step/compile before the
+                     watchdog dumps stacks (None/0 = no watchdog)
+    report_interval: seconds between one-line console reports (None/0 =
+                     no reporter thread)
+    peak_flops:      device peak FLOP/s for MFU (None = auto-detect)
+    """
+    disable()
+    with _S.lock:
+        if log_path:
+            _S.rec = FlightRecorder(
+                log_path, max_bytes=max_log_bytes or (64 << 20))
+            _S.rec.record("run_meta", **_run_meta())
+        if peak_flops:
+            _S.peak_flops = float(peak_flops)
+        _S.devices_recorded = False
+        _S.t_enable = time.monotonic()
+        _S.on = True
+        if stall_timeout:
+            _S.dog = Watchdog(stall_timeout, _on_stall).start()
+        if report_interval:
+            stop = threading.Event()
+            t = threading.Thread(target=_report_loop,
+                                 args=(stop, float(report_interval)),
+                                 daemon=True, name="ptpu-monitor-report")
+            t.start()
+            _S.reporter = (t, stop)
+    _register_jax_listener()
+
+
+def disable():
+    with _S.lock:
+        _S.on = False
+        if _S.dog is not None:
+            _S.dog.stop()
+            _S.dog = None
+        if _S.reporter is not None:
+            t, stop = _S.reporter
+            stop.set()
+            _S.reporter = None
+        if _S.rec is not None:
+            _S.rec.close()
+            _S.rec = None
+
+
+def maybe_enable_from_flags():
+    """Flag-driven arming (called from package import): PADDLE_TPU_MONITOR=1
+    turns the monitor on, PADDLE_TPU_MONITOR_LOG names the JSONL,
+    PADDLE_TPU_MONITOR_STALL_TIMEOUT arms the watchdog."""
+    from .. import flags
+    try:
+        if not flags.get_flag("monitor"):
+            return
+    except KeyError:
+        return
+    stall = flags.get_flag("monitor_stall_timeout") or None
+    report = flags.get_flag("monitor_report_interval") or None
+    try:
+        enable(log_path=flags.get_flag("monitor_log") or None,
+               stall_timeout=stall, report_interval=report)
+    except OSError as e:
+        # telemetry must never take the process down: an unwritable log
+        # path degrades to metrics-only instead of failing the import
+        print("paddle_tpu.monitor: flight recorder disabled (%s); "
+              "continuing with metrics only" % e, file=sys.stderr)
+        enable(log_path=None, stall_timeout=stall,
+               report_interval=report)
+
+
+def _run_meta():
+    """Process metadata only — deliberately NO jax device queries:
+    enable() may run at 'import paddle_tpu' time (env-armed), and
+    touching jax.local_devices() there would initialize the backend
+    before jax.distributed.initialize() / jax_num_cpu_devices updates
+    in launcher code. Device info lands in a later `devices` event
+    (_maybe_record_devices) once the program is actually running."""
+    meta = {"pid": os.getpid(), "argv": sys.argv[:8],
+            "python": sys.version.split()[0]}
+    try:
+        import jax
+        meta["jax"] = jax.__version__
+    except Exception:
+        pass
+    return meta
+
+
+def _maybe_record_devices():
+    """Emit the one-shot `devices` event on the first step/compile —
+    by then jax is in real use, so the backend query is safe."""
+    if _S.devices_recorded or _S.rec is None:
+        return
+    _S.devices_recorded = True
+    try:
+        import jax
+        devs = jax.local_devices()
+        _S.rec.record("devices", platform=devs[0].platform,
+                      device_kind=getattr(devs[0], "device_kind", ""),
+                      device_count=jax.device_count())
+    except Exception:
+        pass
+
+
+# -- executor hooks --------------------------------------------------------
+
+def feed_nbytes(feed_arrays):
+    """Host bytes that will cross to the device this step (jax.Arrays
+    are already resident and cost nothing)."""
+    import numpy as np
+    total = 0
+    for v in feed_arrays.values():
+        if isinstance(v, (np.ndarray, np.generic)):
+            total += v.nbytes
+    return total
+
+
+def tokens_in_feeds(feed_arrays):
+    """Heuristic tokens-per-step: the largest integer-dtype feed is the
+    token ids (LM src [B, T], classifier labels [B, 1], ...). Dense-only
+    programs report their largest leading dim (samples/step)."""
+    if _S.tokens_override:
+        return _S.tokens_override
+    import numpy as np
+    best = 0
+    lead = 0
+    for k, v in feed_arrays.items():
+        if k.endswith("@LOD") or k.endswith("@ACCUM_TOKENS"):
+            continue
+        dt = getattr(v, "dtype", None)
+        shape = getattr(v, "shape", ())
+        if dt is not None and np.issubdtype(dt, np.integer) and shape:
+            best = max(best, int(np.prod(shape)))
+        if shape:
+            lead = max(lead, int(shape[0]))
+    return best or lead
+
+
+def on_compile(program, key, feed_sig, cost_fn=None, executor="exe",
+               tokens=0, devices=1):
+    """Cache-miss hook: classify the compile, price the step with the
+    static cost model, flight-record the event. `key` is the executor's
+    cache key; `devices` is how many chips run the step (scales the
+    MFU denominator — the cost model priced the GLOBAL batch)."""
+    if not _S.on:
+        return
+    # snapshot: a concurrent disable() may null these mid-hook, and
+    # telemetry must never throw into the hot path
+    rec, dog = _S.rec, _S.dog
+    _maybe_record_devices()
+    version = getattr(program, "_version", None)
+    # classify under the lock: two threads compiling the same program
+    # concurrently (a supported Executor pattern) must not both read
+    # count==0 and report new_program, hiding a real recompile
+    with _S.lock:
+        ent = _S.programs.setdefault(
+            program, {"versions": set(), "sigs": set(), "pairs": set(),
+                      "count": 0})
+        if ent["count"] == 0:
+            reason = "new_program"
+        elif version not in ent["versions"]:
+            reason = "program_version"
+        elif feed_sig not in ent["sigs"]:
+            reason = "feed_signature"
+        elif (version, feed_sig) not in ent["pairs"]:
+            # both components seen before, just never together — the
+            # key churned on their combination, not on an option flag
+            reason = "key_combination"
+        else:
+            # same (version, sig) compiled again: an option in the key
+            # (amp/check_nan/fuse flags, fetch list, state keys) moved
+            reason = "options"
+        recompile = ent["count"] > 0
+        ent["count"] += 1
+        ent["versions"].add(version)
+        ent["sigs"].add(feed_sig)
+        ent["pairs"].add((version, feed_sig))
+
+    CACHE_MISSES.inc()
+    COMPILES.inc(reason=reason)
+    if recompile:
+        RECOMPILES.inc()
+
+    flops = nbytes = None
+    if cost_fn is not None and _flag("monitor_cost_model"):
+        try:
+            flops, nbytes = cost_fn()   # traces — NOT under the lock
+            # keyed by VALUE: each run() builds a fresh (equal) key tuple
+            with _S.lock:
+                _S.costs[key] = {"flops": flops, "bytes": nbytes,
+                                 "tokens": tokens,
+                                 "devices": max(1, devices)}
+                _S.costs.move_to_end(key)
+                while len(_S.costs) > _COSTS_CAP:
+                    _S.costs.popitem(last=False)
+            STEP_FLOPS.set(flops)
+        except Exception:
+            pass  # cost model is advisory; never fail a compile over it
+    if dog is not None:
+        dog.touch()
+    if rec is not None:
+        rec.record("compile", executor=executor, reason=reason,
+                   recompile=recompile, program=id(program),
+                   version=version, flops=flops, bytes=nbytes,
+                   tokens=tokens)
+    _sample_device_memory()
+
+
+def on_cache_hit():
+    if _S.on:
+        CACHE_HITS.inc()
+
+
+def sync_every():
+    """The monitor_sync_every flag (>= 1), read per step (cheap)."""
+    from .. import flags
+    try:
+        return max(1, int(flags.get_flag("monitor_sync_every")))
+    except KeyError:
+        return 1
+
+
+class StepTimer:
+    """Per-executor window state for the monitor_sync_every
+    amortization, shared by Executor and ParallelExecutor (one code
+    path for the windowing logic). Thread-safe: a shared executor
+    driven from two threads must never crash or corrupt the window."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._t0 = None
+
+    def begin(self, now):
+        """Count this step into the window; True when the caller should
+        sync (end of window)."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            self._count += 1
+            return self._count >= sync_every()
+
+    def end_synced(self, now, step_t0):
+        """Window-average per-step seconds; resets the window. step_t0
+        is the fallback base when a concurrent thread already closed
+        the window (never throws into the hot path)."""
+        with self._lock:
+            base = self._t0 if self._t0 is not None else step_t0
+            n = max(1, self._count)
+            self._count = 0
+            self._t0 = None
+            return max(0.0, (now - base) / n)
+
+
+def step_timer(obj):
+    """The per-executor StepTimer, lazily attached to the instance."""
+    t = obj.__dict__.get("_mon_sync")
+    if t is None:
+        t = obj.__dict__.setdefault("_mon_sync", StepTimer())
+    return t
+
+
+def on_step(key, dt, feed_bytes=0, tokens=0, executor="exe",
+            synced=True):
+    """Step-completion hook. synced=True: dt is real (blocked) wall
+    time — feeds the latency histogram and the MFU/tokens-s gauges.
+    synced=False (monitor_sync_every amortization on async pipelines):
+    dt is dispatch time only — the step still counts and logs, but is
+    excluded from latency/throughput derivations."""
+    if not _S.on:
+        return
+    rec, dog = _S.rec, _S.dog    # see on_compile: disable() race
+    _maybe_record_devices()
+    STEPS.inc(executor=executor)
+    if synced:
+        STEP_SECONDS.observe(dt, executor=executor)
+    if feed_bytes:
+        FEED_BYTES.inc(feed_bytes)
+    mfu = None
+    with _S.lock:
+        cost = _S.costs.get(key) if key is not None else None
+        if cost is not None:
+            _S.costs.move_to_end(key)   # keep hot step keys resident
+    if synced and cost is not None and dt > 0:
+        if _S.peak_flops is None:
+            _S.peak_flops = _auto_peak_flops() or 0.0
+        if _S.peak_flops:
+            # whole-program FLOPs over ALL participating chips' peak
+            mfu = cost["flops"] / dt \
+                / (_S.peak_flops * cost.get("devices", 1))
+            MFU.set(mfu)
+    tps = None
+    if synced and tokens and dt > 0:
+        tps = tokens / dt
+        TOKENS_PER_SEC.set(tps)
+    if dog is not None:
+        dog.touch()
+    with _S.lock:
+        _S.step_serial += 1
+        serial = _S.step_serial
+    if rec is not None:
+        rec.record("step", executor=executor, n=serial,
+                   dt=dt, feed_bytes=feed_bytes, tokens=tokens,
+                   mfu=mfu, tokens_per_sec=tps, synced=synced)
+    # route the step span into the host profiler timeline when tracing
+    from .. import profiler as _prof
+    if _prof._enabled:
+        _prof.add_span("monitor.step", time.perf_counter() - dt, dt)
+    _sample_device_memory()
+
+
+def on_nan_trip(where, detail=""):
+    if not _S.on:
+        return
+    rec = _S.rec
+    NAN_TRIPS.inc(where=where)
+    if rec is not None:
+        rec.record("nan_guard", where=where, detail=detail)
+
+
+_mem_sample_counter = [0]
+
+
+def _sample_device_memory():
+    """Live/peak device bytes. On TPU allocator stats are one cheap
+    call — sample every time; the CPU fallback walks jax.live_arrays()
+    (O(arrays)), so it samples only when profile_memory is on. The
+    platform is queried once and cached — it cannot change."""
+    if _S.platform is None:
+        try:
+            import jax
+            _S.platform = jax.local_devices()[0].platform
+        except Exception:
+            return
+    from .. import profiler as _prof
+    if _S.platform != "tpu" and not _prof.memory_enabled():
+        return
+    try:
+        live, peak = _prof.device_memory()
+        HBM_LIVE.set(live)
+        HBM_PEAK.set(peak)
+    except Exception:
+        pass
+
+
+def _flag(name):
+    from .. import flags
+    try:
+        return flags.get_flag(name)
+    except KeyError:
+        return True
+
+
+# -- jax compile-time listener ---------------------------------------------
+
+def _register_jax_listener():
+    with _S.lock:
+        if _S.listener_registered:
+            return
+        _S.listener_registered = True
+    try:
+        import jax.monitoring as jm
+
+        def _listener(event, duration, **kw):
+            if not _S.on or "compile" not in event:
+                return
+            rec, dog = _S.rec, _S.dog
+            what = event.rsplit("/", 1)[-1]
+            XLA_COMPILE_SECONDS.observe(duration, what=what)
+            if dog is not None:
+                # compile phases count as liveness: a long first compile
+                # (tracing, lowering, backend_compile each emit duration
+                # events) must not read as a stall. A single compile
+                # PHASE longer than the deadline can still fire — size
+                # stall_timeout above the worst expected compile phase.
+                dog.touch()
+            if rec is not None and duration >= 0.01:
+                rec.record("xla_compile", what=what, seconds=duration)
+
+        jm.register_event_duration_secs_listener(_listener)
+    except Exception:
+        pass
+
+
+# -- stall + reporter ------------------------------------------------------
+
+def _on_stall(idle, stacks):
+    rec = _S.rec
+    STALLS.inc()
+    snap = _REG.snapshot()
+    msg = ("paddle_tpu.monitor WATCHDOG: no step/compile completed for "
+           "%.1fs — dumping %d thread stacks" % (idle, len(stacks)))
+    print(msg, file=sys.stderr)
+    for label, stack in stacks.items():
+        print("--- thread %s ---" % label, file=sys.stderr)
+        print("\n".join(stack[-12:]), file=sys.stderr)
+    if rec is not None:
+        rec.record("stall", idle_seconds=idle, stacks=stacks,
+                   metrics=snap)
+        rec.flush()
+
+
+def _report_loop(stop, interval):
+    last_steps = 0
+    while not stop.wait(interval):
+        if not _S.on:
+            continue
+        s = summary()
+        d = s["steps"] - last_steps
+        last_steps = s["steps"]
+        line = ("monitor: steps=%d (+%d) p50=%s p95=%s recompiles=%d"
+                % (s["steps"], d, _fmt_s(s["p50_s"]), _fmt_s(s["p95_s"]),
+                   s["recompiles"]))
+        if s.get("mfu") is not None:
+            line += " mfu=%.1f%%" % (100 * s["mfu"])
+        if s.get("tokens_per_sec"):
+            line += " tok/s=%.0f" % s["tokens_per_sec"]
+        print(line, file=sys.stderr)
+
+
+def _fmt_s(v):
+    return "n/a" if v is None else "%.1fms" % (1000 * v)
+
+
+# -- snapshots -------------------------------------------------------------
+
+def summary():
+    """One-look health dict (reporter line / bench.py stamp)."""
+    steps = sum(STEPS.snapshot().values())
+    out = {
+        "steps": steps,
+        "p50_s": _best_percentile(0.50),
+        "p95_s": _best_percentile(0.95),
+        "compiles": sum(COMPILES.snapshot().values()),
+        "recompiles": RECOMPILES.value(),
+        "cache_hits": CACHE_HITS.value(),
+        "feed_bytes": FEED_BYTES.value(),
+        "mfu": MFU.value(),
+        "tokens_per_sec": TOKENS_PER_SEC.value(),
+        "stalls": STALLS.value(),
+    }
+    return out
+
+
+def _best_percentile(q):
+    """Percentile over the busiest executor label (the headline series)."""
+    snap = STEP_SECONDS.snapshot()
+    if not snap:
+        return None
+    key = max(snap, key=lambda k: snap[k]["count"])
+    return STEP_SECONDS.percentile(q, executor=key[0])
+
+
+def prometheus_text():
+    return _REG.render_prometheus()
+
+
+def dump_metrics(path):
+    """Write the registry as Prometheus text (.prom) or JSON."""
+    if path.endswith(".json"):
+        _REG.dump_json(path)
+    else:
+        with open(path, "w") as f:
+            f.write(prometheus_text())
+
+
+class MonitorSession:
+    """Handle yielded by session(): .summary() returns the standard
+    summary dict with the COUNT fields (steps/compiles/recompiles/
+    cache_hits/feed_bytes/stalls) as deltas for the session's span;
+    percentiles and gauges are ambient last-values."""
+
+    _DELTA_KEYS = ("steps", "compiles", "recompiles", "cache_hits",
+                   "feed_bytes", "stalls")
+
+    def __init__(self, before):
+        self._before = before
+        self._after = None
+
+    def _freeze(self):
+        self._after = summary()
+
+    def summary(self):
+        cur = self._after if self._after is not None else summary()
+        out = dict(cur)
+        for k in self._DELTA_KEYS:
+            out[k] = cur[k] - self._before[k]
+        return out
+
+
+class _SessionCM:
+    def __init__(self, enable_kwargs):
+        self._kw = enable_kwargs
+        self._own = False
+        self._sess = None
+
+    def __enter__(self):
+        # reuse an ambient session untouched (its recorder/watchdog
+        # config wins); arm a fresh one only when the monitor is off
+        self._own = not _S.on
+        if self._own:
+            enable(**self._kw)
+        self._sess = MonitorSession(summary())
+        return self._sess
+
+    def __exit__(self, *exc):
+        self._sess._freeze()
+        if self._own:
+            disable()
+        return False
+
+
+def session(log_path=None, **enable_kwargs):
+    """``with monitor.session(log_path=...) as s:`` — the one shared
+    arm-unless-ambient pattern (harness.monitored_run, benchmarks).
+    Never resets the registry (counters are monotonic by contract);
+    ``s.summary()`` reports the block's own counts as deltas."""
+    return _SessionCM(dict(log_path=log_path, **enable_kwargs))
+
+
+def reset_for_tests():
+    """Clear metric series and compile history (test isolation)."""
+    disable()
+    _REG.reset()
+    _S.programs.clear()
+    _S.costs.clear()
+    _S.tokens_override = None
+    _S.peak_flops = None       # an explicit/auto peak must not leak
+    _S.devices_recorded = False
+    _S.platform = None
+    _S.step_serial = 0

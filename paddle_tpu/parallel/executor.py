@@ -186,8 +186,12 @@ class ParallelExecutor:
                self._accum_steps, self._accum_loss_norm,
                get_flag("fuse_conv_bn"),
                tuple(sorted(static_info.items())))
+        from .. import monitor as _mon
+        mon_on = _mon.enabled()
         entry = self._cache.get(key)
         repl = NamedSharding(self.mesh, PartitionSpec())
+        if entry is not None and mon_on:
+            _mon.on_cache_hit()
         if entry is None:
             built = self._exe._build(program, tuple(sorted(feed_arrays)),
                                      fetch_names, state_keys,
@@ -195,6 +199,16 @@ class ParallelExecutor:
                                      check_nan=check_nan,
                                      accum_steps=self._accum_steps,
                                      accum_loss_norm=self._accum_loss_norm)
+            if mon_on:
+                from ..core.executor import _step_costs_safe
+                rng0 = jax.random.key(0)
+                _mon.on_compile(
+                    program, key, key[2],
+                    cost_fn=lambda: _step_costs_safe(
+                        built, dict(state), dict(feed_arrays), rng0),
+                    executor="pexe",
+                    tokens=_mon.tokens_in_feeds(feed_arrays),
+                    devices=self.device_count)
 
             def fn(state, feeds, key, _fn=built, _amp=use_amp):
                 # lowering reads the AMP flag at TRACE time; pin it for
@@ -254,8 +268,27 @@ class ParallelExecutor:
         feeds_dev = {k: to_global(v, repl if k in lod_keys else data_sh)
                      for k, v in feed_arrays.items()}
 
+        import time as _time
+        t0 = _time.perf_counter() if mon_on else 0.0
+        if mon_on:
+            # windowed sync (monitor_sync_every) — shared StepTimer,
+            # same windowing as core Executor.run
+            timer = _mon.step_timer(self)
+            do_sync = timer.begin(t0)
         fetches, new_state, guards, fetch_lods = entry(
             state_dev, feeds_dev, rng_key)
+        if mon_on:
+            fb = _mon.feed_nbytes(feed_arrays)
+            tk = _mon.tokens_in_feeds(feed_arrays)
+            if do_sync:
+                jax.block_until_ready(fetches)   # honest step latency
+                _mon.on_step(key,
+                             timer.end_synced(_time.perf_counter(), t0),
+                             feed_bytes=fb, tokens=tk, executor="pexe")
+            else:
+                _mon.on_step(key, _time.perf_counter() - t0,
+                             feed_bytes=fb, tokens=tk, executor="pexe",
+                             synced=False)
 
         def local_value(v):
             # a replicated output's sharding spans remote devices; its

@@ -87,9 +87,14 @@ def _run_ticks(apply, xs, s_idx, n_stage, axis_name, with_aux=False):
 
     state0 = jnp.zeros_like(xs[0])
     outputs0 = jnp.zeros_like(xs)
+    # the aux accumulator carries as shape [1], NOT a scalar: jax
+    # 0.4.37's shard_map partial-eval only promotes NON-forwarded scalar
+    # residuals, so a scalar loop-carry tangent crossing the shard_map
+    # boundary gets paired with a rank-referencing spec in the transpose
+    # and raises _SpecError under value_and_grad (the pp x ep failure)
     _, outputs, aux_sum = lax.fori_loop(
         0, n_stage + m - 1, tick,
-        (state0, outputs0, jnp.asarray(0.0, jnp.float32)))
+        (state0, outputs0, jnp.zeros((1,), jnp.float32)))
     # leading singleton axis: the caller's out_spec shards it on pp, so
     # the global result is [S, M, mb, ...] and slicing [-1] pulls ONLY
     # the last stage's buffer — no collective inside the loop or after
@@ -140,9 +145,11 @@ def _run_ticks_interleaved(apply, xs, s_idx, n_stage, axis_name,
 
     state0 = jnp.zeros_like(xs[0])
     outputs0 = jnp.zeros_like(xs)
+    # [1]-shaped aux carry — see _run_ticks for the shard_map
+    # scalar-residual rationale
     _, outputs, aux_sum = lax.fori_loop(
         0, m - 1 + total, tick,
-        (state0, outputs0, jnp.asarray(0.0, jnp.float32)))
+        (state0, outputs0, jnp.zeros((1,), jnp.float32)))
     if with_aux:
         return outputs[None], aux_sum / m
     return outputs[None]
@@ -281,7 +288,9 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
         check_vma=False)
     res = fn(stacked_params, microbatches)
     if with_aux:
-        return res[0][-1], res[1]
+        # aux crosses the shard_map as [1] (scalar-residual workaround
+        # in _run_ticks); hand the caller the scalar it expects
+        return res[0][-1], res[1].reshape(())
     return res[-1]
 
 
@@ -332,5 +341,5 @@ def gpipe_interleaved(stage_fn, stacked_params, microbatches, mesh,
         check_vma=False)
     res = fn(stacked_params, microbatches)
     if with_aux:
-        return res[0][-1], res[1]
+        return res[0][-1], res[1].reshape(())
     return res[-1]

@@ -17,14 +17,37 @@ from collections import defaultdict
 
 __all__ = ["profiler", "tpu_profiler", "cuda_profiler", "reset_profiler",
            "start_profiler", "stop_profiler", "RecordEvent",
-           "export_chrome_trace"]
+           "export_chrome_trace", "add_span"]
 
 # name -> [count, total_s, live_bytes_last, peak_bytes_max]
 _events = defaultdict(lambda: [0, 0.0, 0, 0])
 _trace = []                               # (name, start_s, dur_s, thread)
 _trace_dropped = 0                        # spans past the cap
 _TRACE_CAP = 1_000_000                    # bound host memory on long runs
+_thread_names = {}                        # thread ident -> human name
 _enabled = False
+
+
+def _note_thread():
+    """Remember the current thread's NAME for the chrome-trace metadata
+    lane ("M"-phase thread_name events) and return its ident."""
+    import threading
+    t = threading.current_thread()
+    _thread_names[t.ident] = t.name
+    return t.ident
+
+
+def add_span(name, start_s, dur_s):
+    """Append one externally-timed span to the host trace (the hook
+    paddle_tpu.monitor uses to route its step spans into the same
+    Perfetto timeline as RecordEvent rows). Honors the trace cap."""
+    global _trace_dropped
+    if not _enabled:
+        return
+    if len(_trace) < _TRACE_CAP:
+        _trace.append((name, start_s, dur_s, _note_thread()))
+    else:
+        _trace_dropped += 1
 
 
 def memory_enabled():
@@ -84,9 +107,8 @@ class RecordEvent:
                 ev[2] = live
                 ev[3] = max(ev[3], peak)
             if len(_trace) < _TRACE_CAP:
-                import threading
                 _trace.append((self.name, self._t0, now - self._t0,
-                               threading.get_ident()))
+                               _note_thread()))
             else:
                 global _trace_dropped
                 _trace_dropped += 1
@@ -97,6 +119,7 @@ def reset_profiler():
     global _trace_dropped
     _events.clear()
     del _trace[:]
+    _thread_names.clear()
     _trace_dropped = 0
 
 
@@ -104,12 +127,23 @@ def export_chrome_trace(path):
     """Write recorded events as a chrome://tracing / Perfetto JSON file
     (tools/timeline.py parity — the reference converts its profiler.proto
     Profile with _ChromeTraceFormatter; here host events convert directly;
-    device-side traces come from tpu_profiler's XPlane output)."""
+    device-side traces come from tpu_profiler's XPlane output). "M"-phase
+    metadata names the process and every thread lane (the reference's
+    timeline.py _allocate_pids device/thread naming), so Perfetto shows
+    "MainThread" / "ptpu-monitor-..." instead of raw thread idents."""
     import json
-    events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
-               "ts": start * 1e6, "dur": dur * 1e6,
-               "cat": "host"}
-              for name, start, dur, tid in _trace]
+    events = [{"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+               "args": {"name": "paddle_tpu host"}}]
+    seen_tids = {tid for _, _, _, tid in _trace}
+    for tid in sorted(seen_tids):
+        events.append({"name": "thread_name", "ph": "M", "pid": 0,
+                       "tid": tid,
+                       "args": {"name": _thread_names.get(
+                           tid, "thread-%d" % tid)}})
+    events += [{"name": name, "ph": "X", "pid": 0, "tid": tid,
+                "ts": start * 1e6, "dur": dur * 1e6,
+                "cat": "host"}
+               for name, start, dur, tid in _trace]
     if _trace_dropped:
         # surface the cap: a truncated timeline must say so in-band
         events.append({"name": "TRACE TRUNCATED: %d spans dropped past "

@@ -174,11 +174,18 @@ def test_chrome_trace_export(tmp_path):
             pass
     profiler.stop_profiler(profile_path=str(tmp_path / "p.txt"))
     n = profiler.export_chrome_trace(str(tmp_path / "trace.json"))
-    assert n == 2
     data = json.loads((tmp_path / "trace.json").read_text())
-    names = {e["name"] for e in data["traceEvents"]}
-    assert names == {"step", "inner"}
-    assert all(e["ph"] == "X" and "dur" in e for e in data["traceEvents"])
+    assert n == len(data["traceEvents"])
+    spans = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == {"step", "inner"}
+    assert all("dur" in e for e in spans)
+    # "M"-phase metadata names the process and each thread lane, so
+    # Perfetto shows readable names instead of raw thread idents
+    metas = {e["name"]: e for e in data["traceEvents"] if e["ph"] == "M"}
+    assert metas["process_name"]["args"]["name"] == "paddle_tpu host"
+    import threading
+    assert metas["thread_name"]["args"]["name"] \
+        == threading.current_thread().name
 
 
 def test_init_parallel_env_single_process_noop():

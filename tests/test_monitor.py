@@ -1,0 +1,428 @@
+"""paddle_tpu.monitor unit tests: registry semantics, flight-recorder
+schema + bounding, watchdog stall detection, recompile classification,
+CLI summary, and the profiler trace-cap marker."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.monitor import metrics as mm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    monitor.reset_for_tests()
+    yield
+    monitor.reset_for_tests()
+
+
+# -- registry semantics ----------------------------------------------------
+
+def test_registry_get_or_create_returns_same_object():
+    reg = mm.Registry()
+    a = reg.counter("c", "help", ("op",))
+    b = reg.counter("c", "other help", ("op",))
+    assert a is b
+    # conflicting type or labels for an existing name is an error
+    with pytest.raises(ValueError):
+        reg.gauge("c")
+    with pytest.raises(ValueError):
+        reg.counter("c", label_names=("other",))
+
+
+def test_counter_gauge_histogram_behavior():
+    reg = mm.Registry()
+    c = reg.counter("reqs", "requests", ("op",))
+    c.inc(op="GET")
+    c.inc(3, op="GET")
+    c.inc(op="PUT")
+    assert c.value(op="GET") == 4
+    assert c.value(op="PUT") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, op="GET")          # counters are monotonic
+    with pytest.raises(ValueError):
+        c.inc(kind="GET")            # undeclared label name
+
+    g = reg.gauge("temp")
+    g.set(3.5)
+    g.inc(0.5)
+    assert g.value() == 4.0
+
+    h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(0.605)
+    p50 = h.percentile(0.5)
+    assert 0.01 <= p50 <= 0.1        # both middle samples sit there
+    assert h.percentile(0.99) <= 1.0
+
+
+def test_prometheus_render_and_snapshot():
+    reg = mm.Registry()
+    reg.counter("a_total", "a", ("k",)).inc(2, k='v"q')
+    reg.gauge("b").set(1.5)
+    reg.histogram("h", buckets=(1.0,)).observe(0.5)
+    text = reg.render_prometheus()
+    assert '# TYPE a_total counter' in text
+    assert 'a_total{k="v\\"q"} 2' in text      # label escaping
+    assert '# TYPE h histogram' in text
+    assert 'h_bucket{le="+Inf"} 1' in text
+    assert 'h_count 1' in text
+    snap = reg.snapshot()
+    assert snap["b"]["series"][""] == 1.5
+    json.dumps(snap)                           # snapshot is JSON-able
+
+
+def test_registry_thread_safety():
+    reg = mm.Registry()
+    c = reg.counter("n")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value() == 8000
+
+
+# -- flight recorder -------------------------------------------------------
+
+def test_flight_recorder_schema_and_bounding(tmp_path):
+    path = str(tmp_path / "fr.jsonl")
+    rec = monitor.FlightRecorder(path, max_bytes=400)
+    assert rec.record("run_meta", pid=1)
+    n_ok = 0
+    for i in range(50):
+        if rec.record("step", n=i, dt=0.001):
+            n_ok += 1
+    rec.close()
+    events = monitor.read_jsonl(path)      # every line parses, ts+ev set
+    assert events[0]["ev"] == "run_meta"
+    assert all("ts" in e for e in events)
+    # the cap produced an in-band truncated marker, not a corrupt tail
+    assert any(e["ev"] == "truncated" for e in events)
+    assert rec.dropped == 50 - n_ok > 0
+    # non-JSON-able values degrade to repr instead of raising
+    rec2 = monitor.FlightRecorder(str(tmp_path / "fr2.jsonl"))
+    assert rec2.record("note", obj=object())
+    rec2.close()
+    evs = monitor.read_jsonl(str(tmp_path / "fr2.jsonl"))
+    assert "object object" in evs[0]["obj"]
+
+
+def test_flight_recorder_budget_survives_reopen(tmp_path):
+    # append mode must count pre-existing bytes toward max_bytes, or
+    # every re-enable() hands the same file a fresh budget
+    path = str(tmp_path / "re.jsonl")
+    rec = monitor.FlightRecorder(path, max_bytes=300)
+    for i in range(20):
+        rec.record("step", n=i)
+    rec.close()
+    # a NEW instance over the full file has no budget left: payload
+    # events are refused immediately (only its own in-band truncated
+    # marker may be appended), instead of a fresh 300-byte allowance
+    rec2 = monitor.FlightRecorder(path, max_bytes=300)
+    assert rec2.record("step", n=99) is False
+    assert rec2.dropped == 1
+    rec2.close()
+    events = monitor.read_jsonl(path)      # file stays parseable
+    assert not any(e["ev"] == "step" and e.get("n") == 99
+                   for e in events)
+
+
+def test_histogram_bucket_conflict_raises():
+    reg = mm.Registry()
+    reg.histogram("h", buckets=(0.1, 1.0))
+    assert reg.histogram("h", buckets=(0.1, 1.0)) is reg.get("h")
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("h", buckets=(0.001, 0.01))
+
+
+def test_read_jsonl_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"ts": 1, "ev": "ok"}\nnot json\n')
+    with pytest.raises(ValueError, match="line 2"):
+        monitor.read_jsonl(str(p))
+
+
+# -- watchdog --------------------------------------------------------------
+
+def test_watchdog_fires_on_stall_and_rearms():
+    fired = []
+    dog = monitor.Watchdog(0.2, lambda idle, stacks: fired.append(
+        (idle, stacks)), check_interval=0.05).start()
+    try:
+        # UNARMED until the first touch: setup time is not a stall
+        time.sleep(0.5)
+        assert not fired
+        dog.touch()                       # first step/compile arms it
+        time.sleep(0.6)
+        assert len(fired) == 1            # fires ONCE per stall, no spam
+        idle, stacks = fired[0]
+        assert idle >= 0.2
+        assert any("MainThread" in k for k in stacks)
+        dog.touch()                       # stepping resumed -> re-armed
+        time.sleep(0.5)
+        assert len(fired) == 2
+    finally:
+        dog.stop()
+
+
+def test_watchdog_via_enable_records_stall_event(tmp_path):
+    log = str(tmp_path / "stall.jsonl")
+    monitor.enable(log_path=log, stall_timeout=0.2)
+    # one real step arms the watchdog; then the "training" stalls
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    time.sleep(0.7)
+    monitor.disable()
+    evs = monitor.read_jsonl(log)
+    stalls = [e for e in evs if e["ev"] == "stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["idle_seconds"] >= 0.2
+    assert stalls[0]["stacks"]
+    assert "ptpu_stalls_total" in stalls[0]["metrics"]
+
+
+# -- recompile counter -----------------------------------------------------
+
+def _tiny_program():
+    x = fluid.layers.data("x", [8])
+    y = fluid.layers.fc(x, 4)
+    return fluid.layers.mean(y)
+
+
+def test_recompile_counter_fires_on_feed_shape_change(tmp_path):
+    log = str(tmp_path / "rc.jsonl")
+    monitor.enable(log_path=log)
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rec0 = monitor.registry().get("ptpu_recompiles_total").value()
+    xv = np.random.rand(4, 8).astype(np.float32)
+    exe.run(feed={"x": xv}, fetch_list=[loss])
+    assert monitor.registry().get("ptpu_recompiles_total").value() == rec0
+    exe.run(feed={"x": xv}, fetch_list=[loss])           # cache hit
+    assert monitor.registry().get(
+        "ptpu_compile_cache_hits_total").value() >= 1
+    # forced feed-SIGNATURE change: same program, new shape -> recompile
+    exe.run(feed={"x": np.random.rand(6, 8).astype(np.float32)},
+            fetch_list=[loss])
+    assert monitor.registry().get(
+        "ptpu_recompiles_total").value() == rec0 + 1
+    monitor.disable()
+    comps = [e for e in monitor.read_jsonl(log) if e["ev"] == "compile"]
+    recomp = [c for c in comps if c["recompile"]]
+    assert len(recomp) == 1
+    assert recomp[0]["reason"] == "feed_signature"
+    # the static cost model priced the step for the MFU gauge
+    assert any(c.get("flops") for c in comps)
+
+
+# -- step telemetry + CLI --------------------------------------------------
+
+def test_step_events_and_cli_summary(tmp_path, capsys):
+    log = str(tmp_path / "run.jsonl")
+    monitor.enable(log_path=log, peak_flops=1e12)
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(4, 8).astype(np.float32)
+    for _ in range(3):
+        exe.run(feed={"x": xv}, fetch_list=[loss])
+    monitor.disable()
+
+    steps = [e for e in monitor.read_jsonl(log) if e["ev"] == "step"]
+    assert len(steps) == 4               # startup + 3 train steps
+    assert all(e["dt"] > 0 for e in steps)
+    assert steps[-1]["feed_bytes"] == xv.nbytes
+    assert steps[-1]["mfu"] is not None  # peak_flops given -> MFU derived
+
+    from paddle_tpu.monitor.__main__ import main as cli_main
+    assert cli_main([log]) == 0
+    out = capsys.readouterr().out
+    assert "steps       4" in out
+    assert "p50" in out and "p95" in out and "recompiles" in out
+    assert cli_main([log, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["steps"] == 4
+    assert summary["p50_s"] > 0
+    assert summary["mean_mfu"] is not None
+
+
+def test_summary_and_prometheus_text():
+    monitor.enable()
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"x": np.random.rand(4, 8).astype(np.float32)},
+            fetch_list=[loss])
+    s = monitor.summary()
+    assert s["steps"] == 2 and s["compiles"] == 2
+    assert s["p50_s"] is not None
+    text = monitor.prometheus_text()
+    assert "ptpu_steps_total" in text
+    assert "ptpu_step_seconds_bucket" in text
+    monitor.disable()
+
+
+def test_sync_every_amortization(tmp_path):
+    from paddle_tpu import flags
+    log = str(tmp_path / "amort.jsonl")
+    monitor.enable(log_path=log)
+    flags.set_flag("monitor_sync_every", 4)
+    try:
+        loss = _tiny_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())   # synced step 1 of 4?
+        xv = np.random.rand(4, 8).astype(np.float32)
+        for _ in range(8):
+            exe.run(feed={"x": xv}, fetch_list=[loss])
+    finally:
+        flags.set_flag("monitor_sync_every", 1)
+        monitor.disable()
+    reg = monitor.registry()
+    # every step counts; only the per-window synced ones hit the
+    # latency histogram (9 steps -> 2 completed windows of 4)
+    assert reg.get("ptpu_steps_total").value(executor="exe") == 9
+    assert reg.get("ptpu_step_seconds").count(executor="exe") == 2
+    steps = [e for e in monitor.read_jsonl(log) if e["ev"] == "step"]
+    assert sum(1 for e in steps if e["synced"]) == 2
+    assert sum(1 for e in steps if not e["synced"]) == 7
+    # CLI percentiles ignore the unsynced dispatch-time samples
+    from paddle_tpu.monitor.__main__ import summarize_log
+    s = summarize_log(log)
+    assert s["steps"] == 9 and s["p50_s"] > 0
+
+
+def test_flight_recorder_stops_after_truncated_marker(tmp_path):
+    path = str(tmp_path / "latch.jsonl")
+    rec = monitor.FlightRecorder(path, max_bytes=250)
+    rec.record("run_meta", pid=1)
+    assert rec.record("stall", big="x" * 500) is False  # overflows
+    # smaller events must NOT slip in after the final marker
+    assert rec.record("step", n=1) is False
+    rec.close()
+    evs = monitor.read_jsonl(path)
+    assert [e["ev"] for e in evs if e["ev"] != "note"] \
+        == ["run_meta", "truncated"]
+
+
+def test_session_deltas_and_ambient_reuse(tmp_path):
+    # ambient session armed; session() must reuse it (no re-enable, no
+    # registry reset) and report only the block's own counts
+    monitor.enable(log_path=str(tmp_path / "amb.jsonl"))
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(4, 8).astype(np.float32)
+    exe.run(feed={"x": xv}, fetch_list=[loss])        # 2 ambient steps
+    with monitor.session(log_path=str(tmp_path / "ignored.jsonl")) as s:
+        exe.run(feed={"x": xv}, fetch_list=[loss])
+        exe.run(feed={"x": xv}, fetch_list=[loss])
+    assert monitor.enabled()                  # ambient session survives
+    assert s.summary()["steps"] == 2          # delta, not cumulative 4
+    assert monitor.summary()["steps"] == 4    # global counters intact
+    monitor.disable()
+    # own-session mode: arms and disarms around the block
+    with monitor.session() as s2:
+        assert monitor.enabled()
+        exe.run(feed={"x": xv}, fetch_list=[loss])
+    assert not monitor.enabled()
+    assert s2.summary()["steps"] == 1
+
+
+def test_tokens_heuristic_and_override():
+    feeds = {"src": np.zeros((4, 16), np.int64),
+             "x": np.zeros((32, 8), np.float32)}
+    assert monitor.tokens_in_feeds(feeds) == 64     # largest int feed
+    assert monitor.tokens_in_feeds(
+        {"x": np.zeros((32, 8), np.float32)}) == 32  # leading dim
+    monitor.set_tokens_per_step(999)
+    assert monitor.tokens_in_feeds(feeds) == 999
+    monitor.set_tokens_per_step(None)
+
+
+def test_parallel_executor_monitored(tmp_path):
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    from paddle_tpu import parallel
+    log = str(tmp_path / "pexe.jsonl")
+    monitor.enable(log_path=log, peak_flops=1e12)
+    x = fluid.layers.data("x", [8])
+    loss = fluid.layers.mean(fluid.layers.fc(x, 4))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = parallel.make_mesh({"dp": 2})
+    pexe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh)
+    xv = np.random.rand(4, 8).astype(np.float32)
+    for _ in range(2):
+        pexe.run([loss], feed={"x": xv})
+    monitor.disable()
+    reg = monitor.registry()
+    assert reg.get("ptpu_steps_total").value(executor="pexe") == 2
+    assert reg.get("ptpu_step_seconds").count(executor="pexe") == 2
+    evs = monitor.read_jsonl(log)
+    comps = [e for e in evs if e["ev"] == "compile"
+             and e["executor"] == "pexe"]
+    assert len(comps) == 1 and comps[0]["flops"] > 0
+    steps = [e for e in evs if e["ev"] == "step"
+             and e["executor"] == "pexe"]
+    assert len(steps) == 2 and steps[-1]["mfu"] is not None
+
+
+# -- profiler satellites ---------------------------------------------------
+
+def test_trace_truncated_marker_past_cap(tmp_path, monkeypatch):
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    monkeypatch.setattr(profiler, "_TRACE_CAP", 5)
+    profiler.start_profiler()
+    for i in range(9):
+        with profiler.RecordEvent("ev%d" % i):
+            pass
+    profiler._enabled = False
+    path = str(tmp_path / "trace.json")
+    profiler.export_chrome_trace(path)
+    data = json.loads(open(path).read())
+    marks = [e for e in data["traceEvents"]
+             if e["name"].startswith("TRACE TRUNCATED")]
+    assert len(marks) == 1
+    assert "4 spans dropped" in marks[0]["name"]
+    profiler.reset_profiler()
+
+
+def test_monitor_step_spans_route_into_profiler_trace(tmp_path):
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    monitor.enable()
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    profiler.start_profiler()
+    exe.run(feed={"x": np.random.rand(4, 8).astype(np.float32)},
+            fetch_list=[loss])
+    profiler._enabled = False
+    monitor.disable()
+    names = [t[0] for t in profiler._trace]
+    assert "monitor.step" in names
+    path = str(tmp_path / "trace.json")
+    profiler.export_chrome_trace(path)
+    data = json.loads(open(path).read())
+    lanes = [e for e in data["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert lanes and all(e["args"]["name"] for e in lanes)
+    profiler.reset_profiler()
