@@ -68,6 +68,10 @@ from . import monitor  # noqa: F401
 
 # PADDLE_TPU_MONITOR=1 arms runtime telemetry for the whole process
 monitor.maybe_enable_from_flags()
+from . import resilience  # noqa: F401
+
+# PADDLE_TPU_FAULTS='{"rpc": {...}}' arms a seeded fault-injection plan
+resilience.faults.maybe_arm_from_flags()
 from . import distributed  # noqa: F401
 from .distributed import DistributeTranspiler  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401

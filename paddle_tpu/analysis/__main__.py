@@ -9,6 +9,34 @@ JAX_PLATFORMS=cpu; tracing never touches a device.
 import argparse
 import sys
 
+# Runtime-only packages the jaxpr analyzer cannot see into: a broken
+# import here (a bad refactor, a missing stub) would sail straight past
+# the zoo lint, so the CLI gate import-checks them too. Keep in sync
+# with the package layout.
+IMPORT_CHECK_PACKAGES = (
+    "paddle_tpu.resilience",
+    "paddle_tpu.resilience.faults",
+    "paddle_tpu.resilience.retry",
+    "paddle_tpu.resilience.driver",
+    "paddle_tpu.monitor",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.master",
+    "paddle_tpu.distributed.membership",
+)
+
+
+def import_check(packages=IMPORT_CHECK_PACKAGES):
+    """Import every runtime-only package; returns [(name, error), ...]
+    (empty = all clean). Part of the --all CI gate."""
+    import importlib
+    failures = []
+    for name in packages:
+        try:
+            importlib.import_module(name)
+        except Exception as e:        # any failure mode is a gate fail
+            failures.append((name, repr(e)))
+    return failures
+
 
 def main(argv=None):
     p = argparse.ArgumentParser(
@@ -47,6 +75,13 @@ def main(argv=None):
         for name in zoo_names():
             print(name)
         return 0
+
+    failures = import_check()
+    for name, err in failures:
+        print("import-check FAILED: %s (%s)" % (name, err),
+              file=sys.stderr)
+    if failures:
+        return 1
 
     names = zoo_names() if args.all or not args.models else args.models
     unknown = set(names) - set(zoo_names())

@@ -23,6 +23,9 @@ import time
 
 from .rpc import _send_msg, _recv_msg
 from ..monitor import metrics as _metrics
+from ..monitor import runtime as _mon
+from ..resilience import faults as _faults
+from ..resilience.retry import RETRYABLE
 
 __all__ = ["TaskQueue", "MasterServer", "MasterClient"]
 
@@ -176,6 +179,13 @@ class MasterServer:
         self._server.server_close()
 
     def _dispatch(self, sock, op, name, payload):
+        plan = _faults._ACTIVE
+        if plan is not None and plan.has_kill("master") and \
+                plan.should_kill("master", len(self.queue.done)):
+            # hard crash: in-flight request unanswered, queue snapshot
+            # (if configured) is what the restarted master resumes from
+            threading.Thread(target=self.stop, daemon=True).start()
+            raise ConnectionError("injected fault: master killed")
         if op == "GETT":
             task = self.queue.get_task(owner=name)
             if task is None:
@@ -203,49 +213,111 @@ class MasterServer:
 
 
 class MasterClient:
-    """Trainer-side client (go/master/client.go)."""
+    """Trainer-side client (go/master/client.go).
 
-    def __init__(self, endpoint, worker_id="trainer", timeout=30.0):
+    retry / resolver: same contract as rpc.RPCClient — every master
+    verb is safe to re-issue (GETT is at-least-once BY DESIGN: a
+    re-leased task's first lease simply expires; DONE/FAIL are
+    idempotent pops; PING reads), so with a retry Policy the client
+    transparently reconnects — through the resolver when the master
+    itself was replaced — and re-asks."""
+
+    def __init__(self, endpoint, worker_id="trainer", timeout=30.0,
+                 retry=None, resolver=None):
         host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.settimeout(timeout)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._retry = retry
+        self._resolver = resolver
+        self._sock = None
         self.worker_id = worker_id
+        self._connect()
+
+    def _connect(self):
+        if self._resolver is not None:
+            try:
+                ep = self._resolver()
+            except Exception:
+                ep = None
+            if ep:
+                host, port = ep.rsplit(":", 1)
+                self._addr = (host, int(port))
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.settimeout(self._timeout)
+        self._sock = s
+
+    def _drop_conn(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _retrying(self, what, body):
+        if self._retry is None:
+            if self._sock is None:
+                self._connect()
+            return body()
+
+        def attempt():
+            if self._sock is None:
+                self._connect()
+                _mon.on_reconnect("master")
+            return body()
+
+        return self._retry.run(
+            attempt, what=what, retry_on=RETRYABLE,
+            on_retry=lambda a, e: self._drop_conn())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def get_task(self):
         """Returns (task_id, payload) or (None, status): status 'done' when
         the epoch is complete, 'wait' when tasks are pending elsewhere."""
-        _send_msg(self._sock, "GETT", self.worker_id)
-        op, name, payload = _recv_msg(self._sock)
-        if op == "NONE":
-            return None, name
-        return int(name), json.loads(payload.decode())
+        def body():
+            _send_msg(self._sock, "GETT", self.worker_id)
+            op, name, payload = _recv_msg(self._sock)
+            if op == "NONE":
+                return None, name
+            return int(name), json.loads(payload.decode())
+        return self._retrying("master.get_task", body)
 
     def task_done(self, task_id):
-        _send_msg(self._sock, "DONE", str(task_id))
-        assert _recv_msg(self._sock)[0] == "OK"
+        def body():
+            _send_msg(self._sock, "DONE", str(task_id))
+            assert _recv_msg(self._sock)[0] == "OK"
+        self._retrying("master.task_done", body)
 
     def task_failed(self, task_id):
-        _send_msg(self._sock, "FAIL", str(task_id))
-        assert _recv_msg(self._sock)[0] == "OK"
+        def body():
+            _send_msg(self._sock, "FAIL", str(task_id))
+            assert _recv_msg(self._sock)[0] == "OK"
+        self._retrying("master.task_failed", body)
 
     def counts(self):
-        _send_msg(self._sock, "PING", "")
-        op, _, payload = _recv_msg(self._sock)
-        return json.loads(payload.decode())
+        def body():
+            _send_msg(self._sock, "PING", "")
+            op, _, payload = _recv_msg(self._sock)
+            return json.loads(payload.decode())
+        return self._retrying("master.counts", body)
 
     def shutdown_server(self):
         try:
+            if self._sock is None:
+                self._connect()
             _send_msg(self._sock, "EXIT", "")
             _recv_msg(self._sock)
         except (ConnectionError, OSError):
             pass
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop_conn()
 
     def records(self, load_fn, poll_s=0.05):
         """Generator over all records of all tasks (client.go NextRecord):

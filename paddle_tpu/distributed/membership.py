@@ -192,6 +192,13 @@ class KVClient:
                       else b"")
             return _recv_msg(self._sock)
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def put(self, key, value, ttl=None):
         assert self._call("PUT", key, {"value": value, "ttl": ttl})[0] \
             == "OK"

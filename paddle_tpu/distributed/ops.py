@@ -12,6 +12,7 @@ import numpy as np
 
 from ..core.registry import register
 from ..core.selected_rows import SelectedRows
+from ..resilience.retry import default_policy
 from .rpc import RPCClient, StaleIncarnationError
 
 
@@ -48,7 +49,11 @@ def _client(ep):
             _ALL_CACHES.add(cache)
     cli = cache.get(ep)
     if cli is None:
-        cli = cache[ep] = RPCClient(ep)
+        # flag-gated transparent reconnect/retry (rpc_retry, default
+        # on): the executor's tagged round sends are exactly-once
+        # server-side, so a broken socket is re-issued instead of
+        # killing the step — rpc.py documents which verbs qualify
+        cli = cache[ep] = RPCClient(ep, retry=default_policy())
     return cli
 
 

@@ -25,6 +25,9 @@ import numpy as np
 
 from ..core.selected_rows import SelectedRows
 from ..monitor import metrics as _metrics
+from ..monitor import runtime as _mon
+from ..resilience import faults as _faults
+from ..resilience.retry import RETRYABLE
 
 __all__ = ["VariableServer", "RPCClient", "serialize_var",
            "deserialize_var"]
@@ -170,12 +173,20 @@ def _sendall_parts(sock, parts):
 
 
 def _send_msg(sock, op, name="", payload=b""):
-    """payload: bytes or a list of buffers (scatter-gather, no join)."""
+    """payload: bytes or a list of buffers (scatter-gather, no join).
+
+    An armed resilience fault plan hooks the frame here (drop / delay /
+    close-mid-frame / duplicate); disarmed, the hook is one None
+    check."""
     parts = payload if isinstance(payload, list) else [payload]
     total = sum(len(p) for p in parts)
     nb = name.encode()
     head = struct.pack("<4sII", op.encode().ljust(4), len(nb), total) + nb
-    _sendall_parts(sock, [head] + parts)
+    frame = [head] + parts
+    plan = _faults._ACTIVE
+    if plan is not None:
+        plan.on_send(sock, op, frame)   # may sleep or break the conn
+    _sendall_parts(sock, frame)
 
 
 def _recv_exact(sock, n):
@@ -197,6 +208,9 @@ def _recv_into(sock, view):
 
 
 def _recv_msg(sock):
+    plan = _faults._ACTIVE
+    if plan is not None:
+        plan.on_recv(sock)              # may sleep or break the conn
     head = _recv_exact(sock, 12)
     op, nlen, plen = struct.unpack("<4sII", head)
     name = _recv_exact(sock, nlen).decode() if nlen else ""
@@ -378,6 +392,15 @@ class VariableServer:
         return entry["buf"]
 
     def _dispatch(self, sock, op, name, payload):
+        plan = _faults._ACTIVE
+        if plan is not None and \
+                plan.should_kill("pserver", self._round):
+            # hard crash: no reply for the in-flight request, no
+            # checkpoint — exactly what a SIGKILL'd pserver looks like.
+            # stop() must run off-thread (shutdown() handshakes with
+            # serve_forever and would deadlock from a handler thread).
+            threading.Thread(target=self.stop, daemon=True).start()
+            raise ConnectionError("injected fault: pserver killed")
         _RPC_REQS.inc(op=op)
         _RPC_BYTES.inc(len(payload))
         if op in ("SEND", "PUT"):
@@ -591,16 +614,22 @@ class VariableServer:
 
 
     # -- checkpoint / recover (go/pserver/service.go:156-205,346) ------------
-    def checkpoint(self, path):
+    def checkpoint(self, path, keep_last=2):
         """Durably persist the parameter store. The blob goes to a
-        VERSIONED file (path.<round>) and the meta JSON — which names the
-        blob — is atomically renamed into place LAST, so a crash at any
-        point leaves the previous (meta, blob) pair fully recoverable.
-        Older blobs are pruned only after the new meta is durable."""
+        VERSIONED file (path.<round>, CRC computed incrementally while
+        writing — io.write_atomic_blob, shared with the trainer
+        checkpoint path) and the meta JSON — which names the blob — is
+        atomically renamed into place LAST, so a crash at any point
+        leaves the previous (meta, blob) pair fully recoverable. The
+        newest ``keep_last`` (meta, blob) pairs are RETAINED (versioned
+        ``path.meta.<round>`` files + the ``path.meta`` newest-pointer),
+        so recover() can fall back past a blob corrupted ON DISK after
+        a clean write; anything older is pruned only after the new meta
+        is durable."""
         import io as _io
         import json
-        import tempfile
-        import zlib
+
+        from ..io import write_atomic_blob, write_json_atomic
 
         with self._lock:
             arrays = {k: np.asarray(v) for k, v in self.store.items()}
@@ -610,75 +639,170 @@ class VariableServer:
         os.makedirs(d, exist_ok=True)
         buf = _io.BytesIO()
         np.savez(buf, **arrays)
-        data = buf.getvalue()
         blob_name = "%s.%d" % (base, round_no)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(d, blob_name))
-        meta = {"round": round_no, "crc32": zlib.crc32(data),
+        crc = write_atomic_blob(d, blob_name, buf.getbuffer())
+        meta = {"round": round_no, "crc32": crc,
                 "blob": blob_name, "names": sorted(arrays)}
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-        with os.fdopen(fd, "w") as f:
-            json.dump(meta, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path + ".meta")
+        write_json_atomic("%s.meta.%d" % (path, round_no), meta)
+        write_json_atomic(path + ".meta", meta)
+        keep = {round_no}
         for n in os.listdir(d):
-            if n.startswith(base + ".") and n != blob_name \
-                    and not n.endswith((".meta", ".tmp")):
+            if n.startswith(base + ".meta."):
                 try:
-                    os.remove(os.path.join(d, n))
-                except OSError:
+                    keep.add(int(n[len(base) + 6:]))
+                except ValueError:
                     pass
+        keep = set(sorted(keep)[-max(1, keep_last):])
+        for n in os.listdir(d):
+            if not n.startswith(base + ".") or n.endswith(".tmp") \
+                    or n == base + ".meta":
+                continue
+            tail = n[len(base) + 1:]
+            ver = tail[5:] if tail.startswith("meta.") else tail
+            try:
+                if int(ver) in keep:
+                    continue
+            except ValueError:
+                continue
+            try:
+                os.remove(os.path.join(d, n))
+            except OSError:
+                pass
         return meta
 
     def recover(self, path):
-        """Reload a checkpoint written by checkpoint(); returns the round
-        number, or None when absent/corrupt (service.go recover path —
-        a corrupt file is skipped, not trusted). The CRC is checked on the
-        exact bytes that get loaded (no re-read TOCTOU)."""
+        """Reload the NEWEST VALID checkpoint written by checkpoint();
+        returns its round number, or None when nothing valid exists
+        (service.go recover path — a corrupt file is skipped, not
+        trusted). Candidates: the versioned metas newest-first (the
+        ``path.meta`` pointer is just the newest one's copy); a
+        truncated or bit-flipped blob fails its CRC — checked on the
+        exact bytes that get loaded, no re-read TOCTOU — and recovery
+        FALLS BACK to the previous retained pair."""
         import io as _io
         import json
         import zlib
 
-        if not os.path.exists(path + ".meta"):
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        base = os.path.basename(path)
+        metas = []
+        try:
+            for n in os.listdir(d):
+                if n.startswith(base + ".meta."):
+                    try:
+                        metas.append((int(n[len(base) + 6:]),
+                                      os.path.join(d, n)))
+                    except ValueError:
+                        pass
+        except OSError:
             return None
-        with open(path + ".meta") as f:
-            meta = json.load(f)
-        blob = os.path.join(os.path.dirname(os.path.abspath(path)) or ".",
-                            meta.get("blob", os.path.basename(path)))
-        if not os.path.exists(blob):
-            return None
-        with open(blob, "rb") as f:
-            data = f.read()
-        if zlib.crc32(data) != meta["crc32"]:
-            return None
-        with np.load(_io.BytesIO(data)) as loaded:
-            with self._lock:
-                for name in loaded.files:
-                    self.store[name] = loaded[name]
-                self._round = int(meta.get("round", 0))
-        return meta["round"]
+        metas.sort(reverse=True)
+        if not metas and os.path.exists(path + ".meta"):
+            metas = [(-1, path + ".meta")]    # pre-versioning layout
+        for _, meta_path in metas:
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                blob = os.path.join(d, meta.get("blob", base))
+                with open(blob, "rb") as f:
+                    data = f.read()
+                if zlib.crc32(data) != meta["crc32"]:
+                    continue
+                with np.load(_io.BytesIO(data)) as loaded:
+                    with self._lock:
+                        for name in loaded.files:
+                            self.store[name] = loaded[name]
+                        self._round = int(meta.get("round", 0))
+                return meta["round"]
+            except (OSError, ValueError, KeyError,
+                    json.JSONDecodeError):
+                continue
+        return None
 
 
 class RPCClient:
-    """Trainer-side client (grpc_client.h:160-194 RPCClient parity, sync)."""
+    """Trainer-side client (grpc_client.h:160-194 RPCClient parity, sync).
 
-    def __init__(self, endpoint, timeout=60.0):
+    retry:    optional resilience.retry.Policy — idempotent verbs (GET /
+              PRFT / PUT, and SEND / BARR with a ROUND tag, which the
+              server dedups across rounds) transparently reconnect and
+              re-issue on socket errors. Untagged or free-form-tagged
+              SEND / BARR never retry: a blind re-send would
+              double-accumulate (see send_var / barrier).
+    resolver: optional callable returning the CURRENT endpoint, checked
+              on every reconnect — a membership-backed resolver (e.g.
+              ``lambda: kv.get(PS_PREFIX + "0")``) makes the client
+              follow a replacement pserver that recovered from its
+              checkpoint on a new port after a lease expiry.
+    """
+
+    def __init__(self, endpoint, timeout=60.0, retry=None, resolver=None):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
-        self._sock = socket.create_connection(self._addr,
-                                              timeout=timeout)
+        self._timeout = timeout
+        self._retry = retry
+        self._resolver = resolver
+        self._sock = None
+        self._side = []            # lazy chunk-parallel push streams
+        self._connect()
+
+    def _connect(self):
+        if self._resolver is not None:
+            try:
+                ep = self._resolver()
+            except Exception:
+                ep = None
+            if ep:
+                host, port = ep.rsplit(":", 1)
+                self._addr = (host, int(port))
+        s = socket.create_connection(self._addr, timeout=self._timeout)
         # Steady-state recv timeout: a dead/hung server raises
         # socket.timeout instead of deadlocking the whole test suite
         # (grpc deadline parity). barrier() lifts it — a sync-mode barrier
         # legitimately blocks until the slowest trainer arrives.
-        self._sock.settimeout(timeout)
-        self._timeout = timeout
-        self._side = []            # lazy chunk-parallel push streams
+        s.settimeout(self._timeout)
+        self._sock = s
+
+    def _drop_conn(self):
+        """Close the main socket AND every side stream (a reconnect must
+        never reuse a half-used stream's stale bytes) — the connection
+        set rebuilds lazily from scratch."""
+        for s in [self._sock] + self._side:
+            if s is None:
+                continue
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._side = []
+
+    def _retrying(self, what, idempotent, body):
+        """Run a verb body under the retry policy (when configured and
+        the verb is idempotent). The body must re-read self._sock — a
+        retry reconnects, possibly to a REPLACEMENT endpoint via the
+        resolver."""
+        if self._retry is None or not idempotent:
+            if self._sock is None:
+                self._connect()
+            return body()
+
+        def attempt():
+            if self._sock is None:
+                self._connect()
+                _mon.on_reconnect("rpc")
+            return body()
+
+        return self._retry.run(
+            attempt, what=what, retry_on=RETRYABLE,
+            on_retry=lambda a, e: self._drop_conn())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def _streams(self, n):
         while len(self._side) < n:
@@ -688,12 +812,17 @@ class RPCClient:
             self._side.append(s)
         return self._side[:n]
 
-    def _push_value(self, op, wire, value):
+    def _push_value(self, op, wire, value, idempotent=True):
         """SEND/PUT with chunk-parallel streaming for large values: the
         serialized bytes split into _CHUNK_STREAMS ranges pushed
         concurrently over side connections (a single TCP stream is
         syscall-bound ~0.8 GB/s — PERF.md DCN tier), then committed on
         the main socket so ordering/idempotency semantics are untouched."""
+        return self._retrying(
+            "rpc." + op.lower(), idempotent,
+            lambda: self._push_value_once(op, wire, value))
+
+    def _push_value_once(self, op, wire, value):
         parts = _serialize_parts(value)
         total = sum(len(p) for p in parts)
         if total < _CHUNK_THRESHOLD or _CHUNK_STREAMS < 2:
@@ -739,9 +868,16 @@ class RPCClient:
 
     def send_var(self, name, value, tag=None):
         """tag: optional idempotency token — a retried send with the
-        same tag replaces the pending grad server-side (see SEND)."""
+        same tag replaces the pending grad server-side (see SEND).
+
+        Only a ROUND-format tag ('t<id>:i<inc>:s<seq>') licenses the
+        retry policy to re-issue: the server's cross-round dedup
+        (_applied) is keyed by the parsed prefix, so a free-form tag is
+        deduped only within the current round — a replay after the
+        round closed would be accumulated into the NEXT round."""
         wire = name if tag is None else "%s||%s" % (name, tag)
-        self._push_value("SEND", wire, value)
+        self._push_value("SEND", wire, value,
+                         idempotent=_parse_tag(tag)[0] is not None)
 
     def _expect_ok(self):
         op, _, payload = _recv_msg(self._sock)
@@ -751,44 +887,60 @@ class RPCClient:
         assert op == "OK", op
 
     def get_var(self, name):
-        _send_msg(self._sock, "GET", name)
-        op, _, payload = _recv_msg(self._sock)
-        if op == "MISS":
-            raise KeyError("server has no var %r" % name)
-        return deserialize_var(payload)
+        def body():
+            _send_msg(self._sock, "GET", name)
+            op, _, payload = _recv_msg(self._sock)
+            if op == "MISS":
+                raise KeyError("server has no var %r" % name)
+            return deserialize_var(payload)
+        return self._retrying("rpc.get", True, body)
 
     def put_var(self, name, value):
         self._push_value("PUT", name, value)
 
     def prefetch(self, table_name, ids):
-        _send_msg(self._sock, "PRFT", table_name,
-                  serialize_var(np.asarray(ids, np.int64)))
-        op, _, payload = _recv_msg(self._sock)
-        if op == "MISS":
-            raise KeyError("server has no table %r" % table_name)
-        return deserialize_var(payload)
+        def body():
+            _send_msg(self._sock, "PRFT", table_name,
+                      serialize_var(np.asarray(ids, np.int64)))
+            op, _, payload = _recv_msg(self._sock)
+            if op == "MISS":
+                raise KeyError("server has no table %r" % table_name)
+            return deserialize_var(payload)
+        return self._retrying("rpc.prefetch", True, body)
 
     def barrier(self, tag=None):
-        _send_msg(self._sock, "BARR", tag or "")
-        # no deadline: the server replies only after all fan_in trainers
-        # arrive, which can take arbitrarily long (slow peers, compiles)
-        self._sock.settimeout(None)
-        try:
-            self._expect_ok()
-        finally:
-            self._sock.settimeout(self._timeout)
+        # ROUND-tagged barriers are exactly-once server-side across
+        # rounds (_applied, keyed by the parsed tag prefix), so the
+        # retry policy may re-issue them; an untagged or free-form tag
+        # is only deduped within the current round (_barr_seen resets
+        # when it closes) — a replay would count toward the NEXT round,
+        # so those never retry
+        def body():
+            _send_msg(self._sock, "BARR", tag or "")
+            # no deadline: the server replies only after all fan_in
+            # trainers arrive, which can take arbitrarily long (slow
+            # peers, compiles)
+            self._sock.settimeout(None)
+            try:
+                self._expect_ok()
+            finally:
+                sock = self._sock
+                if sock is not None:
+                    try:
+                        sock.settimeout(self._timeout)
+                    except OSError:
+                        pass
+        return self._retrying("rpc.barrier",
+                              _parse_tag(tag)[0] is not None, body)
 
     def shutdown_server(self):
         try:
+            if self._sock is None:
+                self._connect()
             _send_msg(self._sock, "EXIT", "")
             _recv_msg(self._sock)
         except (ConnectionError, OSError):
             pass
 
     def close(self):
-        for s in [self._sock] + self._side:
-            try:
-                s.close()
-            except OSError:
-                pass
-        self._side = []
+        self._drop_conn()

@@ -20,6 +20,10 @@ Registered flags:
   monitor*        —     paddle_tpu.monitor runtime telemetry knobs (arm
                         at import, flight-recorder path, stall watchdog,
                         console reporter, MFU peak/cost-model)
+  faults*         —     paddle_tpu.resilience fault-injection plan
+                        (JSON spec or @path) + decision seed
+  rpc_retry*      —     transparent reconnect/retry of idempotent RPC
+                        verbs (bounded backoff + total deadline)
 
 Distributed bootstrap envs (read by distributed.launch, not here):
   PADDLE_COORDINATOR, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID.
@@ -109,6 +113,25 @@ _register("monitor_cost_model", bool, True,
           "price each compiled step with the paddle_tpu.analysis static "
           "cost model (one extra trace per COMPILE, nothing per step) so "
           "the monitor can derive MFU")
+_register("faults", str, "",
+          "arm a paddle_tpu.resilience fault-injection plan at import: "
+          "a JSON spec, or @/path/to/plan.json (see resilience/faults.py "
+          "for the spec schema). Empty = no injection, zero-cost hooks")
+_register("faults_seed", int, 0,
+          "decision seed for the armed fault plan — a fixed seed gives "
+          "a reproducible chaos run")
+_register("rpc_retry", bool, True,
+          "run idempotent RPC verbs (GET/PRFT/PUT, tagged SEND/BARR, "
+          "master GETT/DONE/FAIL/PING) under the resilience retry "
+          "policy: transparent reconnect + bounded exponential backoff "
+          "on socket errors instead of dying with the first broken "
+          "connection")
+_register("rpc_retry_deadline", float, 6.0,
+          "total wall-clock budget (seconds) for one verb's retry loop "
+          "— sized to ride out a pserver replacement (membership lease "
+          "expiry + checkpoint recovery), after which the error "
+          "propagates. The backoff schedule fills the whole budget "
+          "(attempts are not the limiter)")
 _register("fuse_conv_bn", bool, False,
           "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
           "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "

@@ -21,6 +21,7 @@ import os
 import shutil
 import tempfile
 import zlib
+from io import BytesIO
 
 import numpy as np
 
@@ -31,7 +32,8 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "get_inference_program",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "write_checkpoint_arrays",
+    "write_atomic_blob", "write_json_atomic",
 ]
 
 
@@ -174,43 +176,61 @@ def load_inference_model(dirname, executor, model_filename="__model__",
 # atomic checkpoint (Go pserver pattern: CRC + atomic meta — service.go:346)
 # --------------------------------------------------------------------------
 
-def save_checkpoint(dirname, step, main_program=None, scope=None,
-                    keep_last=3):
-    """Atomic checkpoint: npz written to tmp + fsync + rename; meta JSON with
-    CRC32 written last, also atomically. A reader only trusts checkpoints
-    whose meta exists and whose CRC matches."""
-    scope = scope or global_scope()
-    main_program = main_program or default_main_program()
-    os.makedirs(dirname, exist_ok=True)
-    ckpt_name = "ckpt-%d.npz" % step
-    arrays = {}
-    for v in main_program.list_vars():
-        if v.persistable:
-            val = scope.find_var(v.name)
-            if val is not None:
-                arrays[v.name] = np.asarray(val)
-
+def write_atomic_blob(dirname, filename, data, chunk=1 << 20):
+    """Durably write ``data`` (bytes/memoryview) as ``dirname/filename``
+    via temp + fsync + atomic rename, computing the CRC32 incrementally
+    WHILE writing — one pass over memory, never a re-read from disk
+    (the old save_checkpoint read the whole npz back just to hash it).
+    Shared by io checkpoints and the pserver checkpoint path, which has
+    the serialized bytes in hand anyway. Returns the CRC32."""
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    crc = 0
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
+            mv = memoryview(data)
+            for off in range(0, len(mv), chunk):
+                part = mv[off:off + chunk]
+                crc = zlib.crc32(part, crc)
+                f.write(part)
             f.flush()
             os.fsync(f.fileno())
-        path = os.path.join(dirname, ckpt_name)
-        os.replace(tmp, path)
+        os.replace(tmp, os.path.join(dirname, filename))
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
-    with open(path, "rb") as f:
-        crc = zlib.crc32(f.read())
-    meta = {"step": step, "file": ckpt_name, "crc32": crc}
-    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    return crc
+
+
+def write_json_atomic(path, obj):
+    """Small-file sibling of write_atomic_blob (meta JSONs)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     with os.fdopen(fd, "w") as f:
-        json.dump(meta, f)
+        json.dump(obj, f)
         f.flush()
         os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(dirname, "meta-%d.json" % step))
+    os.replace(tmp, path)
+
+
+def write_checkpoint_arrays(dirname, step, arrays, keep_last=3):
+    """The write half of save_checkpoint, taking already-collected
+    arrays — so resilience.driver can snapshot the scope at a step
+    boundary and hand the fsync to a background thread."""
+    os.makedirs(dirname, exist_ok=True)
+    ckpt_name = "ckpt-%d.npz" % step
+    buf = BytesIO()
+    np.savez(buf, **arrays)
+    crc = write_atomic_blob(dirname, ckpt_name, buf.getbuffer())
+    meta = {"step": step, "file": ckpt_name, "crc32": crc}
+    write_json_atomic(os.path.join(dirname, "meta-%d.json" % step), meta)
+
+    # armed chaos plan: corrupt the n-th checkpoint ON DISK (after the
+    # meta landed) so load_checkpoint's CRC fallback gets exercised
+    from .resilience import faults as _faults
+    plan = _faults._ACTIVE
+    if plan is not None:
+        plan.maybe_corrupt_checkpoint(os.path.join(dirname, ckpt_name))
 
     # prune old checkpoints
     steps = sorted(int(n.split("-")[1].split(".")[0])
@@ -221,6 +241,23 @@ def save_checkpoint(dirname, step, main_program=None, scope=None,
             if os.path.exists(p):
                 os.unlink(p)
     return os.path.join(dirname, ckpt_name)
+
+
+def save_checkpoint(dirname, step, main_program=None, scope=None,
+                    keep_last=3):
+    """Atomic checkpoint: npz written to tmp + fsync + rename; meta JSON with
+    CRC32 written last, also atomically. A reader only trusts checkpoints
+    whose meta exists and whose CRC matches."""
+    scope = scope or global_scope()
+    main_program = main_program or default_main_program()
+    arrays = {}
+    for v in main_program.list_vars():
+        if v.persistable:
+            val = scope.find_var(v.name)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+    return write_checkpoint_arrays(dirname, step, arrays,
+                                   keep_last=keep_last)
 
 
 def load_checkpoint(dirname, main_program=None, scope=None):

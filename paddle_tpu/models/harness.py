@@ -12,6 +12,13 @@ can enumerate every workload.
 execute a zoo entry for a few real steps under paddle_tpu.monitor and
 return the telemetry summary — the one-call health check (step p50,
 recompiles, cost-model MFU) for any model the zoo can name.
+
+``resilient_run(build_fn, feed_fn, steps, ckpt_dir)`` is the
+SELF-HEALING sibling: the same real Executor steps, driven through
+``resilience.driver.resilient_loop`` — periodic checkpoints off the
+step path, auto-resume from the newest valid checkpoint, and the
+NaN/Inf rollback-and-skip guard — so any zoo model can run under an
+armed fault plan (the chaos tests do exactly this with the MLP).
 """
 
 import numpy as np
@@ -73,3 +80,36 @@ def monitored_run(build_fn, feed_fn, steps=3, seed=0, log_path=None,
             for _ in range(steps):
                 exe.run(main, feed=feed_fn(rng), fetch_list=fetch_vars)
     return sess.summary()
+
+
+def resilient_run(build_fn, feed_fn, ckpt_dir, steps=8, seed=0,
+                  checkpoint_every=2, **loop_kwargs):
+    """Run a zoo entry for ``steps`` real Executor steps under
+    ``resilience.driver.resilient_loop``; returns the loop summary
+    (steps, rollbacks, resumed_from, losses, ...). Convention: the
+    FIRST fetch build_fn returns is the loss the NaN guard watches.
+    A fresh program/scope per call; auto-resume means a repeated call
+    with the same ckpt_dir restores the previous call's weights before
+    training (kill-and-resume in one process)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import resilient_loop
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fetch_vars = build_fn()
+        if not isinstance(fetch_vars, (tuple, list)):
+            fetch_vars = (fetch_vars,)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(seed)
+        batches = [feed_fn(rng) for _ in range(steps)]
+
+        def step_fn(step, feeds):
+            outs = exe.run(main, feed=feeds, fetch_list=list(fetch_vars))
+            return outs[0]
+
+        return resilient_loop(step_fn, batches, ckpt_dir, program=main,
+                              scope=scope,
+                              checkpoint_every=checkpoint_every,
+                              **loop_kwargs)

@@ -14,6 +14,8 @@ Schema (every line):
 Event types written by the runtime:
   run_meta | devices | step | compile | xla_compile | nan_guard |
   stall | note | truncated
+Event types written by the resilience tier (paddle_tpu.resilience):
+  fault | retry | reconnect | rollback | resume | checkpoint
 """
 
 import json

@@ -34,7 +34,9 @@ from .watchdog import Watchdog
 __all__ = [
     "enable", "disable", "enabled", "recorder", "set_peak_flops",
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
-    "summary", "session", "prometheus_text", "dump_metrics",
+    "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
+    "on_checkpoint", "summary", "session", "prometheus_text",
+    "dump_metrics",
 ]
 
 _REG = _metrics.registry()
@@ -71,6 +73,24 @@ TOKENS_PER_SEC = _REG.gauge("ptpu_tokens_per_sec",
 STEP_FLOPS = _REG.gauge("ptpu_step_flops",
                         "static cost-model FLOPs of the cached step")
 STALLS = _REG.counter("ptpu_stalls_total", "watchdog stall reports")
+# resilience tier (paddle_tpu.resilience): like the distributed-runtime
+# counters these record unconditionally — a retry storm must be visible
+# even when nobody armed the monitor beforehand
+RETRIES = _REG.counter("ptpu_retries_total",
+                       "retry-policy re-issues of idempotent client "
+                       "verbs", ("what",))
+RECONNECTS = _REG.counter("ptpu_reconnects_total",
+                          "client transparent reconnects", ("what",))
+FAULTS = _REG.counter("ptpu_fault_injections_total",
+                      "armed fault-plan injections", ("kind",))
+ROLLBACKS = _REG.counter("ptpu_rollbacks_total",
+                         "resilient_loop rollback-and-skip recoveries",
+                         ("reason",))
+RESUMES = _REG.counter("ptpu_resumes_total",
+                       "resilient_loop auto-resumes from checkpoint")
+CHECKPOINTS = _REG.counter("ptpu_checkpoints_total",
+                           "resilient_loop checkpoints by mode",
+                           ("mode",))
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -470,6 +490,54 @@ def on_nan_trip(where, detail=""):
     NAN_TRIPS.inc(where=where)
     if rec is not None:
         rec.record("nan_guard", where=where, detail=detail)
+
+
+# -- resilience hooks (paddle_tpu.resilience: retry/faults/driver) ---------
+# Counters always tick (sub-microsecond next to a socket error or an
+# fsync); flight-recorder events land only when a recorder is armed.
+
+def on_retry(what, attempt, error=None):
+    RETRIES.inc(what=what)
+    rec = _S.rec
+    if rec is not None:
+        rec.record("retry", what=what, attempt=attempt,
+                   error=repr(error))
+
+
+def on_reconnect(what):
+    RECONNECTS.inc(what=what)
+    rec = _S.rec
+    if rec is not None:
+        rec.record("reconnect", what=what)
+
+
+def on_fault(kind, site=""):
+    FAULTS.inc(kind=kind)
+    rec = _S.rec
+    if rec is not None:
+        rec.record("fault", kind=kind, site=site)
+
+
+def on_rollback(step, reason):
+    ROLLBACKS.inc(reason=reason)
+    rec = _S.rec
+    if rec is not None:
+        rec.record("rollback", step=step, reason=reason)
+        rec.flush()
+
+
+def on_resume(step):
+    RESUMES.inc()
+    rec = _S.rec
+    if rec is not None:
+        rec.record("resume", step=step)
+
+
+def on_checkpoint(step, path, mode):
+    CHECKPOINTS.inc(mode=mode)
+    rec = _S.rec
+    if rec is not None:
+        rec.record("checkpoint", step=step, path=path, mode=mode)
 
 
 _mem_sample_counter = [0]

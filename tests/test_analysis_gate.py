@@ -19,6 +19,17 @@ def test_zoo_model_is_error_free(model):
     assert not errors, "\n" + report.render_text()
 
 
+def test_import_check_gate_is_clean():
+    """The CLI gate also import-checks runtime-only packages the jaxpr
+    analyzer cannot lint (resilience, monitor, distributed) — a broken
+    import there must fail `python -m paddle_tpu.analysis --all`."""
+    from paddle_tpu.analysis.__main__ import (IMPORT_CHECK_PACKAGES,
+                                              import_check)
+    assert import_check() == []
+    assert "paddle_tpu.resilience" in IMPORT_CHECK_PACKAGES
+    assert import_check(("paddle_tpu.no_such_module",)) != []
+
+
 def test_every_shipped_rule_ran_against_the_zoo():
     """All six built-in rules must exist and be enabled by default —
     a rule silently dropped from the registry would turn the gate into

@@ -189,7 +189,12 @@ def test_watchdog_via_enable_records_stall_event(tmp_path):
     monitor.disable()
     evs = monitor.read_jsonl(log)
     stalls = [e for e in evs if e["ev"] == "stall"]
-    assert len(stalls) == 1
+    # >= 1, not == 1: "fires once per stall" is pinned deterministically
+    # by test_watchdog_fires_on_stall_and_rearms above — here, under CPU
+    # load, a LATE async XLA compile-phase event may land mid-sleep and
+    # legitimately re-arm the dog (compiles count as liveness), making a
+    # second stall correct behavior rather than spam
+    assert stalls
     assert stalls[0]["idle_seconds"] >= 0.2
     assert stalls[0]["stacks"]
     assert "ptpu_stalls_total" in stalls[0]["metrics"]
