@@ -72,6 +72,12 @@ from . import resilience  # noqa: F401
 
 # PADDLE_TPU_FAULTS='{"rpc": {...}}' arms a seeded fault-injection plan
 resilience.faults.maybe_arm_from_flags()
+from . import trace  # noqa: F401
+
+# PADDLE_TPU_TRACE[=rate] arms cross-process distributed tracing (span
+# context rides the RPC frames; merge the fleet's span logs with
+# `python -m paddle_tpu.trace merge`)
+trace.maybe_enable_from_flags()
 from . import distributed  # noqa: F401
 from .distributed import DistributeTranspiler  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401

@@ -19,6 +19,10 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.resilience.retry",
     "paddle_tpu.resilience.driver",
     "paddle_tpu.monitor",
+    "paddle_tpu.trace",
+    "paddle_tpu.trace.runtime",
+    "paddle_tpu.trace.clock",
+    "paddle_tpu.trace.merge",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.master",
     "paddle_tpu.distributed.membership",

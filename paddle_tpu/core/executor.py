@@ -33,6 +33,7 @@ from .program import Program, Variable, default_main_program
 from .scope import Scope, global_scope
 from .places import CPUPlace, Place, _default_place
 from .lod import LoDTensor
+from ..trace import runtime as _trc
 
 _NANGUARD = "__nanguard__"
 
@@ -190,6 +191,22 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
             return_numpy=True, use_program_cache=True):
+        trc = _trc._TRACER
+        if trc is None:
+            return self._run_impl(program, feed, fetch_list,
+                                  feed_var_name, fetch_var_name, scope,
+                                  return_numpy, use_program_cache)
+        # distributed-trace root span per step: RPC verb spans issued
+        # while this step runs (pserver sends/gets, prefetches) nest
+        # under it, making the step the unit of the fleet timeline
+        with trc.span("exe.step"):
+            return self._run_impl(program, feed, fetch_list,
+                                  feed_var_name, fetch_var_name, scope,
+                                  return_numpy, use_program_cache)
+
+    def _run_impl(self, program, feed, fetch_list, feed_var_name,
+                  fetch_var_name, scope, return_numpy,
+                  use_program_cache):
         program = program or default_main_program()
         feed = dict(feed or {})
         fetch_list = list(fetch_list or [])

@@ -21,11 +21,13 @@ import socketserver
 import threading
 import time
 
-from .rpc import _send_msg, _recv_msg
+from .rpc import _send_msg, _recv_msg, _clock_exchange, _clock_reply
 from ..monitor import metrics as _metrics
 from ..monitor import runtime as _mon
 from ..resilience import faults as _faults
 from ..resilience.retry import RETRYABLE
+from ..trace import clock as _clock
+from ..trace import runtime as _trace
 
 __all__ = ["TaskQueue", "MasterServer", "MasterClient"]
 
@@ -149,9 +151,19 @@ class MasterServer:
             def handle(self):
                 try:
                     while True:
-                        op, name, payload = _recv_msg(self.request)
-                        if not outer._dispatch(self.request, op, name,
-                                               payload):
+                        op, name, payload, tctx = _recv_msg(
+                            self.request, want_ctx=True)
+                        trc = _trace._TRACER
+                        if trc is not None and tctx is not None \
+                                and op != "CLKS":
+                            with trc.server_span("master." + op, tctx,
+                                                 op=op):
+                                cont = outer._dispatch(
+                                    self.request, op, name, payload)
+                        else:
+                            cont = outer._dispatch(self.request, op,
+                                                   name, payload)
+                        if not cont:
                             break
                 except (ConnectionError, OSError):
                     pass
@@ -165,6 +177,10 @@ class MasterServer:
         if port_file:
             with open(port_file, "w") as f:
                 f.write(str(self.port))
+        trc = _trace._TRACER
+        if trc is not None:
+            trc.record_server_port(self.port,
+                                   "%s:%d" % (host, self.port))
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
 
@@ -203,6 +219,8 @@ class MasterServer:
         elif op == "PING":
             _send_msg(sock, "OK", "",
                       json.dumps(self.queue.counts()).encode())
+        elif op == "CLKS":
+            _clock_reply(sock)
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()
@@ -245,6 +263,8 @@ class MasterClient:
         s = socket.create_connection(self._addr, timeout=self._timeout)
         s.settimeout(self._timeout)
         self._sock = s
+        if _trace._TRACER is not None:
+            _trace.annotate(endpoint="%s:%d" % self._addr)
 
     def _drop_conn(self):
         if self._sock is not None:
@@ -255,6 +275,17 @@ class MasterClient:
             self._sock = None
 
     def _retrying(self, what, body):
+        trc = _trace._TRACER
+        if trc is None:
+            return self._retrying_inner(what, body)
+        # one logical client span per master verb (attempt children
+        # come from Policy.run, same shape as RPCClient)
+        with trc.span(what, endpoint="%s:%d" % self._addr):
+            out = self._retrying_inner(what, body)
+        self._maybe_clock_probe(trc)
+        return out
+
+    def _retrying_inner(self, what, body):
         if self._retry is None:
             if self._sock is None:
                 self._connect()
@@ -264,11 +295,22 @@ class MasterClient:
             if self._sock is None:
                 self._connect()
                 _mon.on_reconnect("master")
+                _trace.annotate(reconnected=True)
             return body()
 
         return self._retry.run(
             attempt, what=what, retry_on=RETRYABLE,
             on_retry=lambda a, e: self._drop_conn())
+
+    def _maybe_clock_probe(self, trc):
+        """See RPCClient._maybe_clock_probe."""
+        if self._sock is None:
+            return
+        try:
+            _clock.probe(trc, "%s:%d" % self._addr,
+                         lambda: _clock_exchange(self._sock))
+        except (ConnectionError, OSError, ValueError, KeyError):
+            self._drop_conn()
 
     def __enter__(self):
         return self

@@ -21,8 +21,10 @@ import threading
 import time
 import uuid
 
-from .rpc import _send_msg, _recv_msg
+from .rpc import _send_msg, _recv_msg, _clock_reply
 from ..monitor import metrics as _metrics
+from ..trace import clock as _clock
+from ..trace import runtime as _trace
 
 __all__ = ["KVServer", "KVClient", "register_pserver", "wait_for_pservers",
            "TrainerLease"]
@@ -51,8 +53,18 @@ class KVServer:
             def handle(self):
                 try:
                     while True:
-                        op, name, payload = _recv_msg(self.request)
-                        outer._dispatch(self.request, op, name, payload)
+                        op, name, payload, tctx = _recv_msg(
+                            self.request, want_ctx=True)
+                        trc = _trace._TRACER
+                        if trc is not None and tctx is not None \
+                                and op != "CLKS":
+                            with trc.server_span("kv." + op, tctx,
+                                                 op=op, key=name):
+                                outer._dispatch(self.request, op, name,
+                                                payload)
+                        else:
+                            outer._dispatch(self.request, op, name,
+                                            payload)
                         if op == "EXIT":
                             break
                 except (ConnectionError, OSError):
@@ -65,6 +77,9 @@ class KVServer:
         self._server = Server((host, port), Handler)
         self.port = self._server.server_address[1]
         self.endpoint = "%s:%d" % (host, self.port)
+        trc = _trace._TRACER
+        if trc is not None:
+            trc.record_server_port(self.port, self.endpoint)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
         self._sweeper = threading.Thread(
@@ -169,6 +184,8 @@ class KVServer:
                 else:
                     self._data[name] = (ent[0], time.time() + ttl)
                     _send_msg(sock, "OK")
+        elif op == "CLKS":
+            _clock_reply(sock)
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()
@@ -178,19 +195,68 @@ class KVServer:
 
 class KVClient:
     def __init__(self, endpoint, timeout=30.0):
-        import socket as _socket
         host, port = endpoint.rsplit(":", 1)
-        self._sock = _socket.create_connection((host, int(port)),
-                                               timeout=timeout)
-        self._sock.settimeout(timeout)
+        self._addr = (host, int(port))
+        self._timeout = timeout
         self._lock = threading.Lock()
+        self._sock = None
+        with self._lock:
+            self._connect_locked()
+
+    def _connect_locked(self):
+        import socket as _socket
+        s = _socket.create_connection(self._addr,
+                                      timeout=self._timeout)
+        s.settimeout(self._timeout)
+        self._sock = s
 
     def _call(self, op, name="", body=None):
+        trc = _trace._TRACER
+        if trc is None:
+            return self._call_locked(op, name, body)
+        with trc.span("kv." + op.lower(), key=name,
+                      endpoint="%s:%d" % self._addr):
+            out = self._call_locked(op, name, body)
+        self._maybe_clock_probe(trc)
+        return out
+
+    def _call_locked(self, op, name="", body=None):
         with self._lock:
+            if self._sock is None:
+                self._connect_locked()
             _send_msg(self._sock, op, name,
                       json.dumps(body).encode() if body is not None
                       else b"")
             return _recv_msg(self._sock)
+
+    def _drop_conn(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _maybe_clock_probe(self, trc):
+        """Periodic NTP-style offset sample (see RPCClient). The lock
+        inside _call_locked keeps the probe off in-flight traffic. A
+        torn probe (e.g. a timed-out recv whose reply lands later)
+        leaves the stream DESYNCED — drop the connection; the next
+        call reconnects lazily, so long-lived users (the _Lease
+        heartbeat thread keeping a pserver slot alive) survive a
+        single failed probe instead of losing their lease."""
+        try:
+            _clock.probe(trc, "%s:%d" % self._addr,
+                         self._clock_exchange)
+        except (ConnectionError, OSError, ValueError, KeyError):
+            self._drop_conn()
+
+    def _clock_exchange(self):
+        op, _, payload = self._call_locked("CLKS")
+        if op != "OK" or not payload:
+            return None
+        return float(json.loads(payload.decode())["t"])
 
     def __enter__(self):
         return self
@@ -239,10 +305,7 @@ class KVClient:
             pass
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop_conn()
 
 
 PS_PREFIX = "/ps/"

@@ -28,11 +28,24 @@ from ..monitor import metrics as _metrics
 from ..monitor import runtime as _mon
 from ..resilience import faults as _faults
 from ..resilience.retry import RETRYABLE
+from ..trace import clock as _clock
+from ..trace import runtime as _trace
 
 __all__ = ["VariableServer", "RPCClient", "serialize_var",
            "deserialize_var"]
 
 _MAGIC = b"PTV1"
+
+# Optional trace-context block: an armed tracer prefixes a frame with
+#   <4sII>(op=@TRC, nlen=len(ctx), plen=0) + ctx
+# before the real header. '@' can never start a verb (ops are ljust'd
+# uppercase names), so receivers detect and consume the block
+# UNCONDITIONALLY — a disarmed process still interoperates with an
+# armed peer — while headerless (old) frames parse exactly as before.
+# Absent when tracing is disarmed or the ambient span is sampled out,
+# so a disarmed fleet exchanges byte-identical old frames.
+_TRC_OP = b"@TRC"
+_TRC_MAX = 256
 
 # distributed-runtime telemetry (paddle_tpu.monitor registry; a counter
 # bump is sub-microsecond next to a socket round-trip, so these record
@@ -176,12 +189,19 @@ def _send_msg(sock, op, name="", payload=b""):
     """payload: bytes or a list of buffers (scatter-gather, no join).
 
     An armed resilience fault plan hooks the frame here (drop / delay /
-    close-mid-frame / duplicate); disarmed, the hook is one None
-    check."""
+    close-mid-frame / duplicate); an armed tracer prefixes the ambient
+    span's context block (same scatter-gather write — zero extra
+    syscalls). Disarmed, each hook is one None check."""
     parts = payload if isinstance(payload, list) else [payload]
     total = sum(len(p) for p in parts)
     nb = name.encode()
     head = struct.pack("<4sII", op.encode().ljust(4), len(nb), total) + nb
+    trc = _trace._TRACER
+    if trc is not None:
+        wire = trc.wire_context()
+        if wire is not None:
+            head = struct.pack("<4sII", _TRC_OP, len(wire), 0) \
+                + wire + head
     frame = [head] + parts
     plan = _faults._ACTIVE
     if plan is not None:
@@ -207,15 +227,58 @@ def _recv_into(sock, view):
         got += r
 
 
-def _recv_msg(sock):
+def _recv_frame_head(sock):
+    """Read the 12-byte frame head, transparently consuming an optional
+    leading trace-context block — at most ONE, bounded BEFORE
+    allocating: a garbage peer must not drive an unbounded read or pin
+    the handler thread streaming repeated blocks. Returns raw
+    (op_bytes, nlen, plen, ctx_bytes_or_None)."""
+    head = _recv_exact(sock, 12)
+    op, nlen, plen = struct.unpack("<4sII", head)
+    ctx = None
+    if op == _TRC_OP:
+        if not 0 < nlen <= _TRC_MAX or plen:
+            raise ConnectionError(
+                "bad trace-context block (nlen %d plen %d)"
+                % (nlen, plen))
+        ctx = bytes(_recv_exact(sock, nlen))
+        head = _recv_exact(sock, 12)
+        op, nlen, plen = struct.unpack("<4sII", head)
+        if op == _TRC_OP:
+            raise ConnectionError("repeated trace-context block")
+    return op, nlen, plen, ctx
+
+
+def _recv_msg(sock, want_ctx=False):
     plan = _faults._ACTIVE
     if plan is not None:
         plan.on_recv(sock)              # may sleep or break the conn
-    head = _recv_exact(sock, 12)
-    op, nlen, plen = struct.unpack("<4sII", head)
+    op, nlen, plen, ctx = _recv_frame_head(sock)
     name = _recv_exact(sock, nlen).decode() if nlen else ""
     payload = _recv_exact(sock, plen) if plen else b""
+    if want_ctx:
+        # server dispatch loops ask for the propagated span context to
+        # open a child span; replies / old frames carry none
+        return op.strip().decode(), name, payload, ctx
     return op.strip().decode(), name, payload
+
+
+def _clock_exchange(sock):
+    """One CLKS round trip on an IDLE client connection → the server's
+    epoch seconds (None on a non-OK reply). The three timestamps around
+    this call feed trace.clock's midpoint offset estimator."""
+    _send_msg(sock, "CLKS")
+    op, _, payload = _recv_msg(sock)
+    if op != "OK" or not payload:
+        return None
+    return float(json.loads(bytes(payload).decode())["t"])
+
+
+def _clock_reply(sock):
+    """Serve one CLKS probe (shared by the pserver / master / KV
+    dispatchers): reply with this process's epoch clock, stamped as
+    late as possible so the sample sits at the handling midpoint."""
+    _send_msg(sock, "OK", "", json.dumps({"t": time.time()}).encode())
 
 
 def _parse_tag(tag):
@@ -293,19 +356,29 @@ class VariableServer:
             def handle(self):
                 try:
                     while True:
-                        head = _recv_exact(self.request, 12)
-                        op, nlen, plen = struct.unpack("<4sII", head)
+                        op, nlen, plen, tctx = _recv_frame_head(
+                            self.request)
                         op = op.strip().decode()
                         name = _recv_exact(self.request, nlen).decode() \
                             if nlen else ""
                         if op == "CHNK":
                             # receive straight into the shared transfer
-                            # buffer — no per-message temp copy
+                            # buffer — no per-message temp copy (and no
+                            # span: the commit SEND carries the trace)
                             outer._recv_chunk(self.request, name, plen)
                             continue
                         payload = _recv_exact(self.request, plen) \
                             if plen else b""
-                        outer._dispatch(self.request, op, name, payload)
+                        trc = _trace._TRACER
+                        if trc is not None and tctx is not None \
+                                and op != "CLKS":
+                            with trc.server_span("pserver." + op, tctx,
+                                                 op=op, var=name):
+                                outer._dispatch(self.request, op, name,
+                                                payload)
+                        else:
+                            outer._dispatch(self.request, op, name,
+                                            payload)
                         if op == "EXIT":
                             break
                 except (ConnectionError, OSError):
@@ -320,6 +393,12 @@ class VariableServer:
         if port_file:
             with open(port_file, "w") as f:
                 f.write(str(self.port))
+        trc = _trace._TRACER
+        if trc is not None:
+            # merge maps clients' clock-sample peer endpoints to this
+            # process through the registered endpoint/port
+            trc.record_server_port(self.port,
+                                   "%s:%d" % (host, self.port))
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
 
@@ -486,6 +565,8 @@ class VariableServer:
                 self._barrier(sock, name or None)
             else:
                 _send_msg(sock, "OK")   # async mode: barrier is a no-op
+        elif op == "CLKS":
+            _clock_reply(sock)
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()
@@ -762,6 +843,11 @@ class RPCClient:
         # legitimately blocks until the slowest trainer arrives.
         s.settimeout(self._timeout)
         self._sock = s
+        if _trace._TRACER is not None:
+            # the span (verb or retry attempt) learns which endpoint
+            # actually served it — a resolver-followed REPLACEMENT
+            # pserver shows up as a changed endpoint on the attempt
+            _trace.annotate(endpoint="%s:%d" % self._addr)
 
     def _drop_conn(self):
         """Close the main socket AND every side stream (a reconnect must
@@ -781,7 +867,19 @@ class RPCClient:
         """Run a verb body under the retry policy (when configured and
         the verb is idempotent). The body must re-read self._sock — a
         retry reconnects, possibly to a REPLACEMENT endpoint via the
-        resolver."""
+        resolver. With tracing armed, the verb is ONE logical client
+        span; Policy.run opens an attempt child per try, so a retried
+        GET reads as one span with N attempt children in the merged
+        timeline."""
+        trc = _trace._TRACER
+        if trc is None:
+            return self._retrying_inner(what, idempotent, body)
+        with trc.span(what, endpoint="%s:%d" % self._addr):
+            out = self._retrying_inner(what, idempotent, body)
+        self._maybe_clock_probe(trc)
+        return out
+
+    def _retrying_inner(self, what, idempotent, body):
         if self._retry is None or not idempotent:
             if self._sock is None:
                 self._connect()
@@ -791,11 +889,25 @@ class RPCClient:
             if self._sock is None:
                 self._connect()
                 _mon.on_reconnect("rpc")
+                _trace.annotate(reconnected=True)
             return body()
 
         return self._retry.run(
             attempt, what=what, retry_on=RETRYABLE,
             on_retry=lambda a, e: self._drop_conn())
+
+    def _maybe_clock_probe(self, trc):
+        """Periodic NTP-style offset sample against this peer on the
+        idle main connection (call-response protocol: nothing is in
+        flight between verbs). A torn probe leaves the stream desynced
+        — drop the connection and let it rebuild lazily."""
+        if self._sock is None:
+            return
+        try:
+            _clock.probe(trc, "%s:%d" % self._addr,
+                         lambda: _clock_exchange(self._sock))
+        except (ConnectionError, OSError, ValueError, KeyError):
+            self._drop_conn()
 
     def __enter__(self):
         return self

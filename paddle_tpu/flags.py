@@ -22,6 +22,9 @@ Registered flags:
                         console reporter, MFU peak/cost-model)
   faults*         —     paddle_tpu.resilience fault-injection plan
                         (JSON spec or @path) + decision seed
+  trace*          —     paddle_tpu.trace cross-process distributed
+                        tracing (sampling rate, span-log path, lane
+                        label, clock-probe interval)
   rpc_retry*      —     transparent reconnect/retry of idempotent RPC
                         verbs (bounded backoff + total deadline)
 
@@ -120,6 +123,26 @@ _register("faults", str, "",
 _register("faults_seed", int, 0,
           "decision seed for the armed fault plan — a fixed seed gives "
           "a reproducible chaos run")
+_register("trace", str, "",
+          "arm paddle_tpu.trace cross-process distributed tracing at "
+          "import: '1'/'true' records every root span, a float in "
+          "(0, 1] head-samples that fraction of roots "
+          "(PADDLE_TPU_TRACE=0.01 for fleets). Span context propagates "
+          "through RPC frames; arm the WHOLE fleet together. Empty/0 = "
+          "off, zero-cost hooks (one is-None check per site)")
+_register("trace_log", str, "",
+          "span-log JSONL path ('{pid}' substitutes the process id — "
+          "each process needs its own file). Empty = "
+          "ptpu_trace_<pid>.jsonl in the cwd. Merge the fleet's logs: "
+          "python -m paddle_tpu.trace merge *.jsonl -o timeline.json")
+_register("trace_proc", str, "",
+          "process label for the merged fleet-timeline lane (default: "
+          "the executable basename) — e.g. trainer0, pserver1")
+_register("trace_clock_interval", float, 15.0,
+          "seconds between NTP-style clock-offset probes per peer "
+          "(midpoint method over an idle RPC round trip; the merge CLI "
+          "uses the min-RTT sample to skew-correct timestamps). <=0 "
+          "probes at every opportunity")
 _register("rpc_retry", bool, True,
           "run idempotent RPC verbs (GET/PRFT/PUT, tagged SEND/BARR, "
           "master GETT/DONE/FAIL/PING) under the resilience retry "

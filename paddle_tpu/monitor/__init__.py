@@ -19,7 +19,8 @@ Summarize a recorded log: ``python -m paddle_tpu.monitor run.jsonl``.
 
 from .metrics import (Counter, Gauge, Histogram, Registry,  # noqa: F401
                       registry)
-from .recorder import FlightRecorder, read_jsonl  # noqa: F401
+from .recorder import (FlightRecorder, read_jsonl,  # noqa: F401
+                       read_jsonl_tolerant)
 from .watchdog import Watchdog, thread_stacks  # noqa: F401
 from .runtime import (  # noqa: F401
     enable, disable, enabled, recorder, set_peak_flops,

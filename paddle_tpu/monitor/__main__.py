@@ -13,19 +13,14 @@ import argparse
 import json
 import sys
 
-from .recorder import read_jsonl
-
-
-def _percentile(sorted_vals, q):
-    if not sorted_vals:
-        return None
-    idx = min(len(sorted_vals) - 1,
-              max(0, int(round(q * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
+from .recorder import percentile_sorted as _percentile
+from .recorder import read_jsonl_tolerant
 
 
 def summarize_log(path):
-    events = read_jsonl(path)
+    # tolerant parse: a LIVE run's log legitimately ends mid-record
+    # when the writer is killed — skip-and-count instead of raising
+    events, skipped = read_jsonl_tolerant(path)
     steps = [e for e in events if e["ev"] == "step"]
     compiles = [e for e in events if e["ev"] == "compile"]
     # latency percentiles use SYNCED samples only: unsynced steps
@@ -61,6 +56,7 @@ def summarize_log(path):
         "nan_trips": sum(1 for e in events if e["ev"] == "nan_guard"),
         "stalls": sum(1 for e in events if e["ev"] == "stall"),
         "truncated": any(e["ev"] == "truncated" for e in events),
+        "skipped_lines": skipped,
     }
     return out
 
@@ -95,6 +91,9 @@ def render(s):
         lines.append("  NaN trips   %d" % s["nan_trips"])
     if s["stalls"]:
         lines.append("  STALLS      %d" % s["stalls"])
+    if s.get("skipped_lines"):
+        lines.append("  skipped     %d partial/torn line(s) (live or "
+                     "killed writer)" % s["skipped_lines"])
     return "\n".join(lines)
 
 

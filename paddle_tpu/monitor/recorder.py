@@ -25,6 +25,16 @@ import time
 
 __all__ = ["FlightRecorder"]
 
+
+def percentile_sorted(sorted_vals, q):
+    """Nearest-rank percentile over an ASCENDING list; None when empty.
+    Shared by the monitor and trace CLIs summarizing these logs."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
 _DEFAULT_MAX_BYTES = 64 << 20
 
 
@@ -107,6 +117,30 @@ class FlightRecorder:
                 self._f.close()
             finally:
                 self._f = None
+
+
+def read_jsonl_tolerant(path):
+    """Parse a flight-recorder / span log that may still be LIVE: a
+    writer killed mid-record leaves a truncated trailing line (and a
+    crash mid-flush can tear an interior one). Malformed lines are
+    skipped and counted, not fatal. Returns (events, skipped)."""
+    events, skipped = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict) or "ts" not in rec \
+                    or "ev" not in rec:
+                skipped += 1
+                continue
+            events.append(rec)
+    return events, skipped
 
 
 def read_jsonl(path):

@@ -91,6 +91,13 @@ RESUMES = _REG.counter("ptpu_resumes_total",
 CHECKPOINTS = _REG.counter("ptpu_checkpoints_total",
                            "resilient_loop checkpoints by mode",
                            ("mode",))
+# distributed-tracing tier (paddle_tpu.trace): spans land in the span
+# log; these counters make span volume and log-cap losses scrapeable
+TRACE_SPANS = _REG.counter("ptpu_trace_spans_total",
+                           "distributed-trace spans recorded", ("proc",))
+TRACE_DROPPED = _REG.counter(
+    "ptpu_trace_dropped_total",
+    "distributed-trace spans lost (span log capped or absent)")
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -473,9 +480,14 @@ def on_step(key, dt, feed_bytes=0, tokens=0, executor="exe",
         _S.step_serial += 1
         serial = _S.step_serial
     if rec is not None:
+        extra = {}
+        tr = _active_trace_id()
+        if tr is not None:
+            # join this process's step telemetry to the fleet timeline
+            extra["trace"] = tr
         rec.record("step", executor=executor, n=serial,
                    dt=dt, feed_bytes=feed_bytes, tokens=tokens,
-                   mfu=mfu, tokens_per_sec=tps, synced=synced)
+                   mfu=mfu, tokens_per_sec=tps, synced=synced, **extra)
     # route the step span into the host profiler timeline when tracing
     from .. import profiler as _prof
     if _prof._enabled:
@@ -496,26 +508,39 @@ def on_nan_trip(where, detail=""):
 # Counters always tick (sub-microsecond next to a socket error or an
 # fsync); flight-recorder events land only when a recorder is armed.
 
+def _active_trace_id():
+    """Sampled ambient paddle_tpu.trace id (None when disarmed) —
+    stamped on flight-recorder rows so per-process telemetry joins the
+    merged fleet timeline. Inline import: trace imports monitor."""
+    from ..trace import runtime as _trace
+    return _trace.active_trace_id()
+
+
+def _trace_extra():
+    tr = _active_trace_id()
+    return {} if tr is None else {"trace": tr}
+
+
 def on_retry(what, attempt, error=None):
     RETRIES.inc(what=what)
     rec = _S.rec
     if rec is not None:
         rec.record("retry", what=what, attempt=attempt,
-                   error=repr(error))
+                   error=repr(error), **_trace_extra())
 
 
 def on_reconnect(what):
     RECONNECTS.inc(what=what)
     rec = _S.rec
     if rec is not None:
-        rec.record("reconnect", what=what)
+        rec.record("reconnect", what=what, **_trace_extra())
 
 
 def on_fault(kind, site=""):
     FAULTS.inc(kind=kind)
     rec = _S.rec
     if rec is not None:
-        rec.record("fault", kind=kind, site=site)
+        rec.record("fault", kind=kind, site=site, **_trace_extra())
 
 
 def on_rollback(step, reason):

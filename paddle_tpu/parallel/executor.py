@@ -137,6 +137,18 @@ class ParallelExecutor:
                     "over: 'token:<feed_name>'." % sorted(toks))
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        from ..trace import runtime as _trc
+        trc = _trc._TRACER
+        if trc is None:
+            return self._run_impl(fetch_list, feed, feed_dict,
+                                  return_numpy)
+        # distributed-trace root span per step (see core Executor.run)
+        with trc.span("pexe.step"):
+            return self._run_impl(fetch_list, feed, feed_dict,
+                                  return_numpy)
+
+    def _run_impl(self, fetch_list, feed=None, feed_dict=None,
+                  return_numpy=True):
         feed = dict(feed or feed_dict or {})
         program = self._program
         scope = self._scope

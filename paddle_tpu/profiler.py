@@ -17,7 +17,7 @@ from collections import defaultdict
 
 __all__ = ["profiler", "tpu_profiler", "cuda_profiler", "reset_profiler",
            "start_profiler", "stop_profiler", "RecordEvent",
-           "export_chrome_trace", "add_span"]
+           "export_chrome_trace", "add_span", "summary"]
 
 # name -> [count, total_s, live_bytes_last, peak_bytes_max]
 _events = defaultdict(lambda: [0, 0.0, 0, 0])
@@ -134,6 +134,14 @@ def export_chrome_trace(path):
     import json
     events = [{"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
                "args": {"name": "paddle_tpu host"}}]
+    if _trace_dropped:
+        # machine-readable completeness record alongside the visible
+        # instant marker below: tools checking args know EXACTLY how
+        # many spans a capped trace is missing
+        events.append({"name": "trace_dropped", "ph": "M", "pid": 0,
+                       "tid": 0,
+                       "args": {"trace_dropped": _trace_dropped,
+                                "trace_cap": _TRACE_CAP}})
     seen_tids = {tid for _, _, _, tid in _trace}
     for tid in sorted(seen_tids):
         events.append({"name": "thread_name", "ph": "M", "pid": 0,
@@ -155,6 +163,18 @@ def export_chrome_trace(path):
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)
     return len(events)
+
+
+def summary():
+    """Host-trace accounting: recorded event names, span count, and —
+    so a capped trace is visibly incomplete rather than silently short
+    — the spans dropped past the _TRACE_CAP bound."""
+    return {"event_names": len(_events),
+            "total_calls": sum(v[0] for v in _events.values()),
+            "spans": len(_trace),
+            "trace_cap": _TRACE_CAP,
+            "trace_dropped": _trace_dropped,
+            "truncated": _trace_dropped > 0}
 
 
 def start_profiler(state="All"):
@@ -182,6 +202,10 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         if with_mem:
             line += " %14.2f %14.2f" % (live / 1e6, peak / 1e6)
         lines.append(line)
+    if _trace_dropped:
+        lines.append("TRACE TRUNCATED: %d span(s) dropped past the %d "
+                     "cap — the table above is complete, the chrome "
+                     "trace is not" % (_trace_dropped, _TRACE_CAP))
     report = "\n".join(lines)
     try:
         with open(profile_path + ".txt", "w") as f:

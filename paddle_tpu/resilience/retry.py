@@ -73,12 +73,24 @@ class Policy:
         """Call ``fn()`` with retries. ``on_retry(attempt, exc)`` runs
         before each backoff sleep (the clients drop their dead sockets
         there; reconnection happens inside the next ``fn()`` attempt so
-        a refused reconnect counts as a failed attempt, not a crash)."""
+        a refused reconnect counts as a failed attempt, not a crash).
+
+        With paddle_tpu.trace armed and an ambient span open (the
+        client's logical verb span), every try runs inside an
+        ``<what>.attempt`` child span — a retried GET merges into ONE
+        client span with N attempt children, failed attempts carrying
+        their error and the reconnect/endpoint annotations from the
+        client's _connect."""
+        from ..trace import runtime as _trace
         t0 = time.monotonic()
         delays = self.delays()
         attempt = 0
         while True:
+            trc = _trace._TRACER
             try:
+                if trc is not None and trc.current_span() is not None:
+                    with trc.span(what + ".attempt", attempt=attempt + 1):
+                        return fn()
                 return fn()
             except retry_on as exc:
                 attempt += 1
@@ -87,6 +99,7 @@ class Policy:
                         time.monotonic() - t0 + sleep_s > self.deadline:
                     raise
                 _mon.on_retry(what, attempt, exc)
+                _trace.annotate(retries=attempt)
                 if on_retry is not None:
                     on_retry(attempt, exc)
                 time.sleep(sleep_s)

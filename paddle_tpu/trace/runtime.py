@@ -1,0 +1,396 @@
+"""Tracer core: spans, ambient context, wire encoding, span log.
+
+Reference parity: the platform layer's host profiler + device tracer
+pair (platform/profiler.h:26-107, device_tracer.h:32) correlates events
+from many sources into one unified timeline; here the "many sources"
+are PROCESSES (trainer / pserver / master / membership KV), so the
+correlation key is a Dapper-style SpanContext propagated in-band with
+each RPC and the unifier is the merge CLI (trace/merge.py).
+
+Design points:
+
+  * One process-wide ``Tracer`` (``enable()``/``_TRACER``), mirroring
+    resilience.faults' arming: every hook site in the runtime is a
+    single ``_TRACER is None`` check when tracing is disarmed.
+  * Client-side spans are AMBIENT (a thread-local stack): the executor
+    opens a root span per step, RPC verb spans nest under it, retry
+    attempts under the verb span — and ``wire_context()`` reads the
+    stack top to inject into outgoing frames.
+  * Server-side spans are EXPLICIT (never pushed on the stack): a
+    dispatch thread's reply sends must not re-inject the request's
+    context back at the client.
+  * Sampling is decided once at the ROOT (Dapper head sampling) and
+    inherited; unsampled spans still propagate locally (cheap) but are
+    neither recorded nor injected, so a disarmed-or-unsampled fleet
+    exchanges byte-identical old frames.
+  * The span log reuses monitor's FlightRecorder (bounded JSONL,
+    atomic-append, in-band truncation marker). Rows:
+      span        {trace, span, parent, name, t0, dur, pid, proc, tid,
+                   attrs?}
+      clock       {peer, offset, rtt}      (clock.py midpoint samples)
+      server_port {port}                   (port -> pid for the merge)
+      proc_meta   {argv}                   (lane naming)
+"""
+
+import os
+import random
+import sys
+import threading
+import time
+
+from ..monitor import runtime as _mon
+from ..monitor.recorder import FlightRecorder
+
+__all__ = [
+    "SpanContext", "Span", "Tracer", "enable", "disable", "enabled",
+    "tracer", "span", "annotate", "current_span", "active_trace_id",
+    "extract", "maybe_enable_from_flags",
+]
+
+_DEFAULT_MAX_BYTES = 64 << 20
+_ID_BITS = 8              # bytes of entropy per id (16 hex chars)
+
+
+def _new_id():
+    return os.urandom(_ID_BITS).hex()
+
+
+class SpanContext:
+    """The propagated triple + sampling decision (Dapper header)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id, span_id, parent_id=None, sampled=True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.sampled = bool(sampled)
+
+    def child(self):
+        return SpanContext(self.trace_id, _new_id(), self.span_id,
+                           self.sampled)
+
+    def to_wire(self):
+        """Compact wire form: b'<trace16>:<span16>:<0|1>'."""
+        return ("%s:%s:%d" % (self.trace_id, self.span_id,
+                              int(self.sampled))).encode()
+
+    def __repr__(self):
+        return "SpanContext(%s/%s parent=%s sampled=%s)" % (
+            self.trace_id, self.span_id, self.parent_id, self.sampled)
+
+
+def extract(wire):
+    """Parse a wire context (bytes/str) -> SpanContext | None. Never
+    raises: a malformed header from a mismatched peer degrades to
+    untraced, not to a dead connection."""
+    if wire is None:
+        return None
+    try:
+        if isinstance(wire, (bytes, bytearray, memoryview)):
+            wire = bytes(wire).decode("ascii")
+        trace_id, span_id, sampled = wire.split(":")
+        if not trace_id or not span_id:
+            return None
+        return SpanContext(trace_id, span_id, sampled=sampled != "0")
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+class Span:
+    """One timed operation; a context manager. ``ambient`` spans push
+    onto the tracer's thread-local stack (client side) so nested spans
+    and ``wire_context()`` see them; server spans stay off the stack."""
+
+    __slots__ = ("_trc", "ctx", "name", "attrs", "t0", "_pc0",
+                 "_ambient")
+
+    def __init__(self, trc, ctx, name, attrs, ambient):
+        self._trc = trc
+        self.ctx = ctx
+        self.name = name
+        self.attrs = attrs
+        self._ambient = ambient
+        self.t0 = None
+        self._pc0 = None
+
+    def annotate(self, **attrs):
+        self.attrs.update(attrs)
+
+    def __enter__(self):
+        self.t0 = time.time()
+        self._pc0 = time.perf_counter()
+        if self._ambient:
+            self._trc._stack().append(self)
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        dur = time.perf_counter() - self._pc0
+        if self._ambient:
+            stack = self._trc._stack()
+            if stack and stack[-1] is self:
+                stack.pop()
+            elif self in stack:            # never corrupt the ambient
+                stack.remove(self)         # chain on exotic unwinds
+        if self.ctx.sampled:
+            if etype is not None:
+                self.attrs["error"] = repr(exc)
+            self._trc._record_span(self, dur)
+        return False
+
+
+class _NullSpan:
+    """No-op stand-in so call sites can unconditionally ``with``."""
+
+    ctx = None
+
+    def annotate(self, **attrs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Process-wide tracing state + span log writer."""
+
+    def __init__(self, log_path=None, sample_rate=1.0, proc=None,
+                 clock_interval=15.0, max_bytes=_DEFAULT_MAX_BYTES):
+        self.proc = proc or _default_proc()
+        self.pid = os.getpid()
+        self.sample_rate = float(sample_rate)
+        # <=0 means "every opportunity" (tests / short runs)
+        self.clock_interval = float(clock_interval)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._clock_last = {}           # peer endpoint -> monotonic ts
+        self._rng = random.Random(os.urandom(8))
+        self._rec = (FlightRecorder(log_path, max_bytes=max_bytes)
+                     if log_path else None)
+        if self._rec is not None:
+            self._rec.record("proc_meta", pid=self.pid, proc=self.proc,
+                             argv=sys.argv[:4])
+
+    # -- ambient stack -----------------------------------------------------
+    def _stack(self):
+        s = getattr(self._local, "stack", None)
+        if s is None:
+            s = self._local.stack = []
+        return s
+
+    def current_span(self):
+        s = getattr(self._local, "stack", None)
+        return s[-1] if s else None
+
+    def wire_context(self):
+        """Bytes to inject into an outgoing frame, or None (no ambient
+        span / sampled out). Called from rpc._send_msg under the armed
+        branch only."""
+        s = getattr(self._local, "stack", None)
+        if not s:
+            return None
+        ctx = s[-1].ctx
+        if not ctx.sampled:
+            return None
+        return ctx.to_wire()
+
+    # -- span creation -----------------------------------------------------
+    def span(self, name, **attrs):
+        """Child of the ambient span, or a new (sampled-per-rate) root."""
+        cur = self.current_span()
+        if cur is not None:
+            ctx = cur.ctx.child()
+        else:
+            sampled = (self.sample_rate >= 1.0
+                       or self._rng.random() < self.sample_rate)
+            ctx = SpanContext(_new_id(), _new_id(), sampled=sampled)
+        return Span(self, ctx, name, dict(attrs), ambient=True)
+
+    def server_span(self, name, wire_ctx, **attrs):
+        """Child of an EXTRACTED remote context (the request's header).
+        Not ambient: reply sends must not carry it back."""
+        ctx = wire_ctx if isinstance(wire_ctx, SpanContext) \
+            else extract(wire_ctx)
+        if ctx is None:
+            return _NULL_SPAN
+        return Span(self, ctx.child(), name, dict(attrs), ambient=False)
+
+    # -- log rows ----------------------------------------------------------
+    def _record_span(self, span, dur):
+        rec = self._rec
+        row = {"trace": span.ctx.trace_id, "span": span.ctx.span_id,
+               "parent": span.ctx.parent_id, "name": span.name,
+               "t0": span.t0, "dur": dur, "pid": self.pid,
+               "proc": self.proc, "tid": threading.get_ident()}
+        if span.attrs:
+            row["attrs"] = span.attrs
+        if rec is not None and rec.record("span", **row):
+            _mon.TRACE_SPANS.inc(proc=self.proc)
+        else:
+            _mon.TRACE_DROPPED.inc()
+
+    def record_server_port(self, port, endpoint=None):
+        """Servers register their listening port (and, when known, the
+        full host:port endpoint) so the merge can map a client clock
+        sample's peer endpoint to this process — the endpoint
+        disambiguates equal ports on different hosts."""
+        if self._rec is not None:
+            row = {"port": int(port), "pid": self.pid,
+                   "proc": self.proc}
+            if endpoint:
+                row["endpoint"] = endpoint
+            self._rec.record("server_port", **row)
+
+    def clock_due(self, peer):
+        """Rate-limit clock probing per peer (one probe per
+        ``clock_interval`` seconds; <=0 probes at every opportunity)."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._clock_last.get(peer)
+            if last is not None and now - last < self.clock_interval:
+                return False
+            self._clock_last[peer] = now
+        return True
+
+    def record_clock(self, peer, offset, rtt):
+        if self._rec is not None:
+            self._rec.record("clock", peer=peer, offset=offset, rtt=rtt,
+                             pid=self.pid, proc=self.proc)
+
+    def flush(self):
+        if self._rec is not None:
+            self._rec.flush()
+
+    def close(self):
+        if self._rec is not None:
+            self._rec.close()
+
+
+def _default_proc():
+    base = os.path.basename(sys.argv[0] or "")
+    if base.endswith(".py"):
+        base = base[:-3]
+    return base or ("pid%d" % os.getpid())
+
+
+# -- process-wide arming ---------------------------------------------------
+
+_TRACER = None
+
+
+def enable(log_path=None, sample_rate=1.0, proc=None,
+           clock_interval=15.0, max_bytes=_DEFAULT_MAX_BYTES):
+    """Arm tracing process-wide; returns the Tracer. Re-arming replaces
+    (and closes) the previous tracer."""
+    global _TRACER
+    disable()
+    _TRACER = Tracer(log_path=log_path, sample_rate=sample_rate,
+                     proc=proc, clock_interval=clock_interval,
+                     max_bytes=max_bytes)
+    return _TRACER
+
+
+def disable():
+    global _TRACER
+    t, _TRACER = _TRACER, None
+    if t is not None:
+        t.close()
+
+
+def enabled():
+    return _TRACER is not None
+
+
+def tracer():
+    return _TRACER
+
+
+def span(name, **attrs):
+    """``with trace.span("round", step=i):`` — child of the ambient
+    span or a new root; a no-op context manager when disarmed."""
+    t = _TRACER
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, **attrs)
+
+
+def annotate(**attrs):
+    """Attach attributes to the current ambient span (no-op without
+    one) — the hook retry/reconnect/re-resolution sites use."""
+    t = _TRACER
+    if t is None:
+        return
+    cur = t.current_span()
+    if cur is not None:
+        cur.attrs.update(attrs)
+
+
+def current_span():
+    t = _TRACER
+    return t.current_span() if t is not None else None
+
+
+def active_trace_id():
+    """The sampled ambient trace id, or None — monitor stamps it onto
+    flight-recorder rows so per-process telemetry joins the fleet
+    timeline."""
+    t = _TRACER
+    if t is None:
+        return None
+    cur = t.current_span()
+    if cur is None or not cur.ctx.sampled:
+        return None
+    return cur.ctx.trace_id
+
+
+def _parse_rate(raw):
+    """PADDLE_TPU_TRACE value -> sampling rate | None (off). '1'/'true'
+    arm at rate 1.0; a float in (0, 1] samples that fraction of roots."""
+    raw = str(raw).strip().lower()
+    if not raw or raw in ("0", "false", "off", "no"):
+        return None
+    if raw in ("1", "true", "on", "yes"):
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        print("paddle_tpu.trace: unparseable PADDLE_TPU_TRACE=%r — "
+              "tracing stays off" % raw, file=sys.stderr)
+        return None
+    if rate <= 0:
+        return None
+    return min(rate, 1.0)
+
+
+def maybe_enable_from_flags():
+    """Flag-driven arming (called from package import):
+    ``PADDLE_TPU_TRACE[=rate]`` arms, ``PADDLE_TPU_TRACE_LOG`` names the
+    span log ('{pid}' substitutes the process id — every process of a
+    fleet needs its own file), ``PADDLE_TPU_TRACE_PROC`` labels the
+    timeline lane."""
+    from .. import flags
+    try:
+        rate = _parse_rate(flags.get_flag("trace"))
+    except KeyError:
+        return None
+    if rate is None:
+        return None
+    log = flags.get_flag("trace_log") or "ptpu_trace_{pid}.jsonl"
+    log = log.replace("{pid}", str(os.getpid()))
+    proc = flags.get_flag("trace_proc") or None
+    interval = flags.get_flag("trace_clock_interval")
+    try:
+        return enable(log_path=log, sample_rate=rate, proc=proc,
+                      clock_interval=interval)
+    except OSError as e:
+        # tracing must never take the process down: an unwritable log
+        # path leaves tracing off instead of failing the import
+        print("paddle_tpu.trace: span log disabled (%s); tracing stays "
+              "off" % e, file=sys.stderr)
+        return None

@@ -58,20 +58,25 @@ def test_append_backward_grads():
 
 
 def test_sgd_training_decreases_loss():
+    # lr=0.05 x 60 steps left SGD mid-descent (final/first ~ 0.20,
+    # deterministically missing the 10x bar in this environment);
+    # lr=0.2 x 120 steps reaches ratio ~1e-4 with everything pinned
+    # (np seed 0 fixes data AND the fc init draw), so the 10x bar now
+    # holds with >100x margin instead of riding the convergence knee.
     np.random.seed(0)
     x = fluid.layers.data("x", [4])
     label = fluid.layers.data("label", [1])
     pred = fluid.layers.fc(x, 1)
     loss = fluid.layers.mean(
         fluid.layers.square_error_cost(pred, label))
-    opt = fluid.optimizer.SGD(learning_rate=0.05)
+    opt = fluid.optimizer.SGD(learning_rate=0.2)
     opt.minimize(loss)
 
     exe = fluid.Executor(fluid.CPUPlace())
     _run_startup(exe)
     w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
     losses = []
-    for i in range(60):
+    for i in range(120):
         xs = np.random.rand(16, 4).astype(np.float32)
         ys = xs @ w_true + 0.7
         lv, = exe.run(feed={"x": xs, "label": ys}, fetch_list=[loss])
