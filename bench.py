@@ -190,6 +190,24 @@ def main():
 
     monitor_summary = monitor_probe()
 
+    def serving_probe():
+        """Continuous-batching serving smoke (benchmarks/serving_bench
+        fast CPU mode): engine-vs-sequential aggregate tokens/s on a
+        mixed-length request set, with token identity verified. Runs on
+        the CPU backend — the engine's win is scheduling, measured
+        without the tunnel's per-step sync tax — and is stamped into
+        the bench JSON like the monitor probe."""
+        _fresh()
+        _run(["--device", "CPU", "--fast"])
+        try:
+            import serving_bench as smod
+            return importlib.reload(smod).main()
+        except Exception as e:
+            print("serving probe failed: %s" % e, file=sys.stderr)
+            return None
+
+    serving_summary = serving_probe()
+
     import statistics
 
     def agg(samples):
@@ -244,6 +262,10 @@ def main():
         # runtime-telemetry stamp (paddle_tpu.monitor): per-step p50/p95,
         # recompile count and cost-model MFU of the monitored probe
         out["monitor"] = monitor_summary
+    if serving_summary is not None:
+        # continuous-batching stamp (paddle_tpu.serving): engine vs
+        # sequential tokens/s, speedup, occupancy, token identity
+        out["serving"] = serving_summary
     print(json.dumps(out))
 
 

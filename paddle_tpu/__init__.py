@@ -78,6 +78,7 @@ from . import trace  # noqa: F401
 # context rides the RPC frames; merge the fleet's span logs with
 # `python -m paddle_tpu.trace merge`)
 trace.maybe_enable_from_flags()
+from . import serving  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed import DistributeTranspiler  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401

@@ -27,6 +27,10 @@ Registered flags:
                         label, clock-probe interval)
   rpc_retry*      —     transparent reconnect/retry of idempotent RPC
                         verbs (bounded backoff + total deadline)
+  feed_plan_cache bool  cache _normalize_feeds plans + committed device
+                        feed buffers across same-signature run() calls
+  serving*        —     paddle_tpu.serving continuous-batching engine
+                        knobs (prefill chunk length, admission window)
 
 Distributed bootstrap envs (read by distributed.launch, not here):
   PADDLE_COORDINATOR, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID.
@@ -155,6 +159,21 @@ _register("rpc_retry_deadline", float, 6.0,
           "expiry + checkpoint recovery), after which the error "
           "propagates. The backoff schedule fills the whole budget "
           "(attempts are not the limiter)")
+_register("feed_plan_cache", bool, True,
+          "cache _normalize_feeds derivations per feed signature and "
+          "reuse committed device feed buffers across Executor.run calls "
+          "(the PERF.md round-5 in-process serving re-marshal fix); "
+          "0 restores the per-call full normalization")
+_register("serving_prefill_chunk", int, 16,
+          "serving.Engine prompt-prefill chunk length: an admitted "
+          "prompt is written into its slot's KV cache this many tokens "
+          "per engine iteration, so one long prompt cannot stall the "
+          "running decode batch")
+_register("serving_admission_wait", float, 0.0,
+          "serving.Engine wait-for-batch admission window (seconds): an "
+          "IDLE engine holds admissions up to this long for the queue "
+          "to fill to the slot count before starting a sparse batch. "
+          "0 = greedy fill (admit at the next step boundary)")
 _register("fuse_conv_bn", bool, False,
           "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
           "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "

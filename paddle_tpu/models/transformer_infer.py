@@ -325,6 +325,80 @@ class TransformerLMInfer(TransformerInfer):
             x = _ln(x + self._ffn(p, x), *p["ln2"])
         return x[:, 0, :] @ self.w_out, state
 
+    # -- serving (paddle_tpu.serving continuous batching) --------------
+    def _step_logits_slots(self, tok, state, pos, write_mask=None):
+        """Per-slot incremental step for the continuous-batching serving
+        engine: like ``_step_logits`` but every row (slot) reads/writes
+        its OWN cache position, so requests at different depths share one
+        compiled step. tok [S] i32, pos [S] i32 (next cache write index
+        per slot) → (logits [S, V], state). ``write_mask`` [S] bool
+        gates the cache writes: a slot that is idle or still PREFILLING
+        (the engine writes its prompt chunk-by-chunk between decode
+        steps) must not clobber cache entries with its stale tok/pos.
+
+        Row math is identical to ``_step_logits`` (same _mha/_ln/_ffn
+        helpers, same bias constants): a slot's logits depend only on its
+        own row, which is what makes engine output token-identical to the
+        standalone one-at-a-time decode (pinned in tests/test_serving.py).
+        """
+        x = self.word_emb[tok] * (self.d_model ** 0.5) + self.pos_emb[pos]
+        x = x[:, None, :]                                # [S, 1, D]
+        ar = jnp.arange(self.max_len)
+        self_bias = jnp.where(ar[None, :] <= pos[:, None], 0.0,
+                              -1e9)[:, None, None, :]    # [S, 1, 1, L]
+        ridx = jnp.arange(tok.shape[0])
+        # per-slot scatter write (the dynamic_update_slice analog with a
+        # VECTOR of start positions); masked-out rows write at max_len,
+        # which mode="drop" discards
+        wpos = pos if write_mask is None else \
+            jnp.where(write_mask, pos, self.max_len)
+        for i, p in enumerate(self.layers):
+            k_new, v_new = self._kv(p["attn"], x)        # [S, H, 1, dk]
+            k = state["k%d" % i].at[ridx, :, wpos, :].set(
+                k_new[:, :, 0, :], mode="drop")
+            v = state["v%d" % i].at[ridx, :, wpos, :].set(
+                v_new[:, :, 0, :], mode="drop")
+            state["k%d" % i], state["v%d" % i] = k, v
+            a = self._mha(p["attn"], x, k, v, self_bias)
+            x = _ln(x + a, *p["ln1"])
+            x = _ln(x + self._ffn(p, x), *p["ln2"])
+        return x[:, 0, :] @ self.w_out, state
+
+    def _prefill_chunk_slot(self, state, slot, toks, start, n_valid):
+        """Teacher-forced chunk prefill for ONE slot: write the K/V of
+        ``toks[:n_valid]`` at cache positions ``start..start+n_valid-1``.
+        toks is a FIXED-size chunk (one compile per chunk length); the
+        padded tail is masked out of the writes. No logits are computed —
+        the output head is dead code here and XLA drops it — so prefill
+        steps cost attention+FFN only."""
+        c = toks.shape[0]
+        idx = jnp.arange(c)
+        cpos = start + idx                               # [C]
+        valid = idx < n_valid
+        gather_pos = jnp.where(valid,
+                               jnp.minimum(cpos, self.max_len - 1), 0)
+        x = self.word_emb[toks] * (self.d_model ** 0.5) \
+            + self.pos_emb[gather_pos]
+        x = x[None]                                      # [1, C, D]
+        ar = jnp.arange(self.max_len)
+        # chunk query i attends cache keys j <= start+i (its own K/V is
+        # written below before the attention reads the cache)
+        bias = jnp.where(ar[None, :] <= cpos[:, None], 0.0,
+                         -1e9)[None, None, :, :]         # [1, 1, C, L]
+        wpos = jnp.where(valid, cpos, self.max_len)      # OOB → dropped
+        for i, p in enumerate(self.layers):
+            k_new, v_new = self._kv(p["attn"], x)        # [1, H, C, dk]
+            k = state["k%d" % i].at[slot, :, wpos, :].set(
+                k_new[0].transpose(1, 0, 2), mode="drop")
+            v = state["v%d" % i].at[slot, :, wpos, :].set(
+                v_new[0].transpose(1, 0, 2), mode="drop")
+            state["k%d" % i], state["v%d" % i] = k, v
+            a = self._mha(p["attn"], x, k[slot][None], v[slot][None],
+                          bias)
+            x = _ln(x + a, *p["ln1"])
+            x = _ln(x + self._ffn(p, x), *p["ln2"])
+        return state
+
     def generate(self, batch, max_out_len=None, beam_size=1,
                  length_penalty=0.0):
         """Generate from BOS. beam_size=1 → greedy ((tokens [B, T],

@@ -35,8 +35,8 @@ __all__ = [
     "enable", "disable", "enabled", "recorder", "set_peak_flops",
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
     "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
-    "on_checkpoint", "summary", "session", "prometheus_text",
-    "dump_metrics",
+    "on_checkpoint", "on_serving_step", "on_feed_plan", "summary",
+    "session", "prometheus_text", "dump_metrics",
 ]
 
 _REG = _metrics.registry()
@@ -98,6 +98,33 @@ TRACE_SPANS = _REG.counter("ptpu_trace_spans_total",
 TRACE_DROPPED = _REG.counter(
     "ptpu_trace_dropped_total",
     "distributed-trace spans lost (span log capped or absent)")
+# serving tier (paddle_tpu.serving): continuous-batching engine health.
+# Counters tick unconditionally (sub-microsecond next to a decode step);
+# the gauges make queue pressure and batch utilization scrapeable live
+SERVING_QUEUE_DEPTH = _REG.gauge(
+    "ptpu_serving_queue_depth",
+    "requests waiting for a decode slot")
+SERVING_SLOT_OCCUPANCY = _REG.gauge(
+    "ptpu_serving_slot_occupancy",
+    "fraction of decode slots active in the last engine step")
+SERVING_TOKENS = _REG.counter(
+    "ptpu_serving_tokens_total",
+    "tokens emitted by the continuous-batching engine")
+SERVING_ADMISSIONS = _REG.counter(
+    "ptpu_serving_admissions_total",
+    "requests admitted into a decode slot")
+SERVING_RETIREMENTS = _REG.counter(
+    "ptpu_serving_retirements_total",
+    "requests retired from a decode slot (EOS or max_new)")
+# feed-plan cache (core/executor): a normalization is the full per-call
+# feed re-marshal PERF.md round 5 measured; a plan hit skipped it
+FEED_NORMALIZATIONS = _REG.counter(
+    "ptpu_feed_normalizations_total",
+    "full _normalize_feeds derivations (feed-plan cache misses or "
+    "uncached callers)")
+FEED_PLAN_HITS = _REG.counter(
+    "ptpu_feed_plan_hits_total",
+    "feed-plan cache hits (per-call feed normalization skipped)")
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -563,6 +590,34 @@ def on_checkpoint(step, path, mode):
     rec = _S.rec
     if rec is not None:
         rec.record("checkpoint", step=step, path=path, mode=mode)
+
+
+# -- serving hooks (paddle_tpu.serving continuous-batching engine) ---------
+
+def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
+                    retired=0, engine="engine"):
+    """One engine iteration completed: gauges reflect the step, counters
+    accumulate, and (recorder armed) a ``serving_step`` row lands with
+    the active trace id so the fleet timeline can join engine steps."""
+    SERVING_QUEUE_DEPTH.set(queue_depth)
+    SERVING_SLOT_OCCUPANCY.set(active / slots if slots else 0.0)
+    if emitted:
+        SERVING_TOKENS.inc(emitted)
+    if admitted:
+        SERVING_ADMISSIONS.inc(admitted)
+    if retired:
+        SERVING_RETIREMENTS.inc(retired)
+    rec = _S.rec
+    if rec is not None:
+        rec.record("serving_step", engine=engine, active=active,
+                   slots=slots, queue_depth=queue_depth,
+                   emitted=emitted, admitted=admitted, retired=retired,
+                   **_trace_extra())
+
+
+def on_feed_plan(hit):
+    """core/executor feed-plan cache outcome for one run() call."""
+    (FEED_PLAN_HITS if hit else FEED_NORMALIZATIONS).inc()
 
 
 _mem_sample_counter = [0]

@@ -61,6 +61,11 @@ class ParallelExecutor:
         self._exe._rng_counter = 0
         self._exe._mesh = self.mesh   # lowerings (sp/pp/ep ops) read this
         self._cache = {}
+        # feed-plan cache (plans only, no device commit: pexe feeds get
+        # mesh shardings downstream) — repeated-shape batches skip the
+        # per-call normalization derivation
+        from ..core.executor import FeedPlanCache
+        self._feed_plans = FeedPlanCache(device_fn=None)
         self._loss_name = loss_name
         # DistributedStrategy execution knobs (mesh axes are consumed by
         # the model builders; these two belong to the executor)
@@ -164,7 +169,8 @@ class ParallelExecutor:
         # buckets the flat LoD totals so signatures stay cache-stable.
         from ..core.executor import _normalize_feeds
         feed_arrays, static_info = _normalize_feeds(
-            feed, accum_steps=self._accum_steps)
+            feed, accum_steps=self._accum_steps,
+            plan_cache=self._feed_plans)
         if self._accum_steps > 1:
             self._check_accum_weights(feed_arrays)
         lod_keys = {k for k in feed_arrays

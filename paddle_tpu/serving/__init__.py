@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — continuous-batching inference engine.
+
+The serving story of PERF.md round 5 in one number: bs1 greedy decode
+sits at the XLA while-loop step floor while bs32 buys ~23x the tokens
+for ~1.4x the step latency. The engine closes that gap for real traffic
+by keeping a fixed-capacity slot batch full: requests are admitted at
+STEP boundaries into retired slots (Orca's iteration-level scheduling),
+prompts prefill chunk-by-chunk so a long admission cannot stall the
+running batch, and the compiled step shape never changes while requests
+of different lengths come and go.
+
+Quickstart::
+
+    from paddle_tpu import serving
+    eng = serving.Engine(infer, slots=8)      # infer: TransformerLMInfer
+    reqs = [eng.submit([1, 5, 9], max_new_tokens=32) for _ in range(64)]
+    for r in reqs:
+        tokens, score = r.result()
+    eng.close()
+
+or the synchronous convenience ``eng.generate_many(prompts, 32)``.
+``sequential_generate`` is the one-at-a-time baseline the engine is
+benchmarked (and token-identity-tested) against.
+"""
+
+from .engine import (Engine, Request,  # noqa: F401
+                     sequential_generate)
+
+__all__ = ["Engine", "Request", "sequential_generate"]

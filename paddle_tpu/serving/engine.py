@@ -1,0 +1,417 @@
+"""Continuous-batching decode engine: slot state + iteration scheduler.
+
+Reference parity: the reference served generation through the C-API's
+one-request-at-a-time ``GradientMachine::forward`` loop (capi/
+gradient_machine.h) — PERF.md round 4/5 measured the equivalent path
+here (bs1 KV-cached decode) at the per-step dispatch floor, ~23x below
+the same chip's bs32 throughput. The engine is the standard fix, after
+Orca (iteration-level scheduling) and vLLM (slot/block-managed caches):
+
+  * **Slot-based decode state** — ONE compiled step over a fixed
+    [slots, ...] KV cache (models/transformer_infer
+    ``_step_logits_slots``) with per-slot write positions, active masks
+    and sampling state (greedy + cumulative log-prob). The compiled
+    shape never changes as requests of different lengths come and go.
+  * **Iteration-level scheduler** — a thread-safe queue feeding
+    admissions at step boundaries: slots retire on EOS / max_new and
+    refill mid-flight; an admitted prompt prefills CHUNK by chunk
+    (``_prefill_chunk_slot``, one chunk per engine iteration) so one
+    long prompt cannot stall the running batch; the admission policy is
+    greedy fill by default with an optional wait-for-batch window.
+
+Every engine iteration is instrumented: monitor gauges/counters
+(``ptpu_serving_*``), a ``serving_step`` flight-recorder row carrying
+the active trace id, and an ``engine.step`` trace span.
+"""
+
+import collections
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..monitor import runtime as _monrt
+from ..trace import runtime as _trc
+
+__all__ = ["Engine", "Request", "sequential_generate"]
+
+
+class Request:
+    """One submitted generation request; also the result handle.
+
+    ``result()`` blocks until the engine retires the request and returns
+    ``(tokens, score)`` — the greedy continuation (EOS included when hit,
+    at most ``max_new`` tokens) and the sum of token log-probs."""
+
+    __slots__ = ("prompt", "max_new", "tokens", "score", "_event",
+                 "_error")
+
+    def __init__(self, prompt, max_new):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.tokens = []
+        self.score = None
+        self._event = threading.Event()
+        self._error = None
+
+    def _finish(self, score):
+        self.score = score
+        self._event.set()
+
+    def _fail(self, err):
+        self._error = err
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                "request not finished within %r s" % (timeout,))
+        if self._error is not None:
+            raise RuntimeError(
+                "serving engine failed: %r" % (self._error,))
+        return list(self.tokens), self.score
+
+
+def _flag(name, default):
+    from .. import flags
+    try:
+        return flags.get_flag(name)
+    except KeyError:
+        return default
+
+
+class Engine:
+    """Continuous-batching engine over a KV-cached incremental decoder.
+
+    ``model`` is a ``models.transformer_infer.TransformerLMInfer`` (or
+    anything exposing the same slot-step protocol: ``_init_state``,
+    ``_step_logits_slots``, ``_prefill_chunk_slot``, ``max_len``,
+    ``end_id``, ``bos_id``). ``slots`` is the fixed decode batch
+    capacity; ``prefill_chunk`` the per-iteration prompt chunk length
+    (flag ``serving_prefill_chunk``); ``admission_wait`` an optional
+    wait-for-batch window in seconds applied when the engine is idle
+    (flag ``serving_admission_wait``; 0 = greedy fill)."""
+
+    def __init__(self, model, slots=8, prefill_chunk=None,
+                 admission_wait=None, name="engine"):
+        if slots < 1:
+            raise ValueError("slots must be >= 1, got %r" % (slots,))
+        self.model = model
+        self.slots = int(slots)
+        self.name = name
+        self._chunk = int(prefill_chunk
+                          if prefill_chunk is not None
+                          else _flag("serving_prefill_chunk", 16))
+        self._chunk = max(1, min(self._chunk, model.max_len))
+        self._admission_wait = float(
+            admission_wait if admission_wait is not None
+            else _flag("serving_admission_wait", 0.0))
+        self._cv = threading.Condition()
+        self._queue = collections.deque()
+        self._recs = [None] * self.slots   # loop-thread-only slot records
+        self._stop = False
+        self._error = None                 # loop-death cause, if any
+        self._state = self._init_state()
+        self._step_fn = jax.jit(self._step_impl, donate_argnums=0)
+        self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=0)
+        self._activate_fn = jax.jit(self._activate_impl, donate_argnums=0)
+        self.stats = {"steps": 0, "decode_steps": 0, "tokens": 0,
+                      "admissions": 0, "retirements": 0,
+                      "active_slot_steps": 0, "prefill_chunks": 0}
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ptpu-" + name)
+        self._thread.start()
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens):
+        """Enqueue one request; returns its Request handle. ``prompt``
+        is the token-id prefix (≥ 1 token — pass ``[model.bos_id]`` for
+        unconditional generation)."""
+        prompt = [int(t) for t in (prompt or [self.model.bos_id])]
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1, got %d" % max_new)
+        # cache positions used: prompt at 0..P-1, generated tokens
+        # continue to P+max_new-2 — past max_len the pos-emb gather and
+        # the cache writes would clamp and corrupt state; fail loudly
+        if len(prompt) + max_new - 1 > self.model.max_len:
+            raise ValueError(
+                "prompt len %d + max_new %d exceeds model max_len %d"
+                % (len(prompt), max_new, self.model.max_len))
+        req = Request(prompt, max_new)
+        with self._cv:
+            if self._stop:
+                err = getattr(self, "_error", None)
+                if err is not None:
+                    raise RuntimeError(
+                        "engine is closed (loop died: %r)" % (err,))
+                raise RuntimeError("engine is closed")
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req
+
+    @staticmethod
+    def result(request, timeout=None):
+        return request.result(timeout)
+
+    def generate_many(self, prompts, max_new_tokens):
+        """Synchronous convenience: submit every prompt, block for all
+        results (in input order). ``max_new_tokens`` is a scalar or a
+        per-prompt sequence."""
+        n = len(prompts)
+        if not hasattr(max_new_tokens, "__len__"):
+            max_new_tokens = [max_new_tokens] * n
+        reqs = [self.submit(p, m)
+                for p, m in zip(prompts, max_new_tokens)]
+        return [r.result() for r in reqs]
+
+    def occupancy(self):
+        """Mean active-slot fraction over the decode steps run so far."""
+        d = self.stats["decode_steps"] * self.slots
+        return self.stats["active_slot_steps"] / d if d else 0.0
+
+    def close(self):
+        """Stop the engine loop. Requests still queued or in flight are
+        failed (their ``result()`` raises)."""
+        with self._cv:
+            already = self._stop
+            self._stop = True
+            self._cv.notify_all()
+        if already:
+            return
+        self._thread.join()
+        self._fail_all(RuntimeError("engine closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- compiled pieces ---------------------------------------------------
+    def _init_state(self):
+        s = self.model._init_state(self.slots)
+        z = lambda dt: jnp.zeros((self.slots,), dt)
+        s["tok"], s["pos"], s["count"] = z(jnp.int32), z(jnp.int32), \
+            z(jnp.int32)
+        s["active"] = z(bool)
+        s["score"] = z(jnp.float32)
+        s["max_new"] = jnp.ones((self.slots,), jnp.int32)
+        return s
+
+    def _step_impl(self, state):
+        """One decode iteration over all slots: greedy-sample every
+        active slot, advance its cache position, flag retirements."""
+        state = dict(state)
+        tok, pos, active = state["tok"], state["pos"], state["active"]
+        logits, state = self.model._step_logits_slots(
+            tok, state, pos, write_mask=active)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        tok_logp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+        end = jnp.int32(self.model.end_id)
+        emit = jnp.where(active, nxt, end)
+        count = state["count"] + active.astype(jnp.int32)
+        fin = active & ((nxt == end) | (count >= state["max_new"]))
+        state["score"] = state["score"] + jnp.where(active, tok_logp, 0.0)
+        state["tok"] = jnp.where(active, nxt, tok)
+        state["pos"] = pos + active.astype(jnp.int32)
+        state["count"] = count
+        state["active"] = active & ~fin
+        return state, emit, fin
+
+    def _prefill_impl(self, state, slot, toks, start, n_valid):
+        return self.model._prefill_chunk_slot(
+            dict(state), slot, toks, start, n_valid)
+
+    def _activate_impl(self, state, slot, tok, pos, max_new):
+        state = dict(state)
+        at = lambda n, v: state[n].at[slot].set(v)
+        state["tok"] = at("tok", tok)
+        state["pos"] = at("pos", pos)
+        state["active"] = at("active", True)
+        state["score"] = at("score", 0.0)
+        state["count"] = at("count", 0)
+        state["max_new"] = at("max_new", max_new)
+        return state
+
+    # -- scheduler loop ----------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                with self._cv:
+                    while (not self._stop and not self._queue
+                           and all(r is None for r in self._recs)):
+                        self._cv.wait()
+                    if self._stop:
+                        return
+                self._step_once()
+        except BaseException as e:      # a dead loop must not hang callers
+            with self._cv:
+                # later submits must raise, not enqueue into a queue
+                # nobody drains
+                self._stop = True
+                self._error = e
+            self._fail_all(e)
+
+    def _step_once(self):
+        """One engine iteration = admissions + one prefill chunk per
+        prefilling slot + one decode step over the active batch."""
+        with _trc.span("engine.step") as sp:
+            admitted = self._admit()
+            self._advance_prefills()
+            active, finished = self._decode()
+            with self._cv:
+                depth = len(self._queue)
+            self.stats["steps"] += 1
+            self.stats["admissions"] += admitted
+            self.stats["retirements"] += len(finished)
+            sp.annotate(active=active, admitted=admitted,
+                        retired=len(finished), queue=depth)
+            _monrt.on_serving_step(
+                active=active, slots=self.slots, queue_depth=depth,
+                emitted=active, admitted=admitted,
+                retired=len(finished), engine=self.name)
+        # wake waiters LAST: a caller returning from result() must see
+        # this iteration's stats/metrics already landed
+        for req, score in finished:
+            req._finish(score)
+
+    def _admit(self):
+        admitted = 0
+        with self._cv:
+            if (self._admission_wait > 0 and self._queue
+                    and all(r is None for r in self._recs)
+                    and len(self._queue) < self.slots):
+                # wait-for-batch window: the engine is idle, so give the
+                # queue a beat to fill before compiling a sparse batch
+                self._cv.wait_for(
+                    lambda: self._stop
+                    or len(self._queue) >= self.slots,
+                    timeout=self._admission_wait)
+            for slot in range(self.slots):
+                if not self._queue:
+                    break
+                if self._recs[slot] is None:
+                    self._recs[slot] = {"req": self._queue.popleft(),
+                                        "cursor": 0, "live": False}
+                    admitted += 1
+        return admitted
+
+    def _advance_prefills(self):
+        """One prompt chunk per prefilling slot per iteration — long
+        prompts interleave with the running batch instead of stalling
+        it. A slot whose prefix is fully written activates (its LAST
+        prompt token seeds the first decode step)."""
+        for slot, rec in enumerate(self._recs):
+            if rec is None or rec["live"]:
+                continue
+            req = rec["req"]
+            need = len(req.prompt) - 1      # teacher-forced prefix
+            cur = rec["cursor"]
+            if cur < need:
+                toks = req.prompt[cur:min(cur + self._chunk, need)]
+                chunk = np.zeros((self._chunk,), np.int32)
+                chunk[:len(toks)] = toks
+                self._state = self._prefill_fn(
+                    self._state, np.int32(slot), chunk, np.int32(cur),
+                    np.int32(len(toks)))
+                rec["cursor"] = cur + len(toks)
+                self.stats["prefill_chunks"] += 1
+            if rec["cursor"] >= need:
+                self._state = self._activate_fn(
+                    self._state, np.int32(slot),
+                    np.int32(req.prompt[-1]), np.int32(need),
+                    np.int32(req.max_new))
+                rec["live"] = True
+
+    def _decode(self):
+        live = [s for s, r in enumerate(self._recs)
+                if r is not None and r["live"]]
+        if not live:
+            return 0, []
+        self._state, emit, fin = self._step_fn(self._state)
+        emit, fin = np.asarray(emit), np.asarray(fin)
+        scores = None
+        finished = []
+        for slot in live:
+            rec = self._recs[slot]
+            rec["req"].tokens.append(int(emit[slot]))
+            if fin[slot]:
+                if scores is None:      # one [S] fetch per iteration
+                    scores = np.asarray(self._state["score"])
+                finished.append((rec["req"], float(scores[slot])))
+                self._recs[slot] = None
+        self.stats["decode_steps"] += 1
+        self.stats["active_slot_steps"] += len(live)
+        self.stats["tokens"] += len(live)
+        return len(live), finished
+
+    def _fail_all(self, err):
+        with self._cv:
+            pending = [r["req"] for r in self._recs if r is not None]
+            pending += list(self._queue)
+            self._queue.clear()
+            self._recs = [None] * self.slots
+        for req in pending:
+            req._fail(err)
+
+
+# -- sequential baseline ---------------------------------------------------
+
+def _seq_step_fn(model):
+    """The jitted single-token greedy step (batch 1), cached on the
+    model so repeated baselines share one compile."""
+    fn = getattr(model, "_serving_seq_step", None)
+    if fn is None:
+        def _impl(tok, state, t):
+            logits, state = model._step_logits(tok, state, t)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+            lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+            return nxt, lp, state
+
+        fn = model._serving_seq_step = jax.jit(_impl)
+    return fn
+
+
+def sequential_generate(model, requests):
+    """One-at-a-time greedy decode — the pre-engine serving loop (the
+    shape the C-API predictor and PERF.md's bs1 line measure): one
+    jitted single-token step at batch 1, a host round-trip per token,
+    requests processed back to back. ``requests``: iterable of
+    ``(prompt, max_new_tokens)``. Returns ``[(tokens, score), ...]``,
+    token-identical to ``Engine`` output (same per-row math)."""
+    step = _seq_step_fn(model)
+    out = []
+    for prompt, max_new in requests:
+        prompt = [int(t) for t in prompt]
+        if len(prompt) + int(max_new) - 1 > model.max_len:
+            # same loud bound as Engine.submit: past max_len the pos-emb
+            # gather and cache writes clamp and silently corrupt output
+            raise ValueError(
+                "prompt len %d + max_new %d exceeds model max_len %d"
+                % (len(prompt), int(max_new), model.max_len))
+        state = model._init_state(1)
+        for t, tk in enumerate(prompt[:-1]):    # teacher-forced prefix
+            _, _, state = step(jnp.full((1,), tk, jnp.int32), state,
+                               np.int32(t))
+        tok, pos = prompt[-1], len(prompt) - 1
+        toks, score = [], 0.0
+        for _ in range(int(max_new)):
+            nxt, lp, state = step(jnp.full((1,), tok, jnp.int32), state,
+                                  np.int32(pos))
+            tok = int(np.asarray(nxt)[0])
+            score += float(np.asarray(lp)[0])
+            toks.append(tok)
+            pos += 1
+            if tok == model.end_id:
+                break
+        out.append((toks, score))
+    return out

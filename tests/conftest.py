@@ -27,6 +27,10 @@ import jax  # noqa: E402
 
 if not _TPU_SWEEP:
     jax.config.update("jax_platforms", "cpu")
+    # NB: do NOT enable the persistent XLA compile cache here — on this
+    # jaxlib (0.4.37 CPU) a cached executable combined with the forced
+    # 8-virtual-device platform aborts the process (SIGABRT) inside
+    # sharded device_put (reproduced via test_parallel_integration).
 else:
     import paddle_tpu as _fluid
     _fluid.CPUPlace = _fluid.TPUPlace
