@@ -13,11 +13,17 @@ imgs/sec on a K40m.
 
 MFU methodology and the measured per-op ceilings backing these numbers:
 PERF.md.
+
+Degradation contract (BENCH_r05 post-mortem): every config runs under
+``guarded`` — transient backend-init failures retry with backoff, any
+final failure is stamped into the JSON's "errors" map and that config
+reports null. The run ALWAYS prints its one JSON line.
 """
 
 import json
 import os
 import sys
+import time
 
 # ResNet-50 train step ~3x fwd FLOPs (fwd 4.1 GFLOP/img @224); v5e peak
 # 197 bf16 TFLOP/s — MFU printed alongside throughput per VERDICT r1 #2.
@@ -28,6 +34,36 @@ PEAK_BF16 = 197e12
 def flops_per_token(L, D, FFN, T, V):
     """Train-step FLOPs per token of a decoder-only LM (3x forward)."""
     return 3 * (L * (8 * D * D + 4 * D * FFN + 4 * T * D) + 2 * D * V)
+
+
+def guarded(label, fn, errors, retries=2, backoff=3.0):
+    """Run one bench config to completion or to a STAMPED error —
+    never an aborted JSON (BENCH_r05 died mid-run on a transient
+    `Unable to initialize backend 'axon'` and recorded nothing).
+    Backend-init failures retry with linear backoff (the axon plugin
+    can lose the chip lease for a beat between configs); any final
+    failure APPENDS to ``errors[label]`` (a list — a config may fail
+    on some of the K interleaved repeats and succeed on others, and
+    the record must keep every loss) and that run reports None."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            if "Unable to initialize backend" in str(e) \
+                    and attempt < retries:
+                attempt += 1
+                wait = backoff * attempt
+                print("%s: backend init failed (%s) — retry %d/%d "
+                      "in %.0fs" % (label, e, attempt, retries, wait),
+                      file=sys.stderr)
+                time.sleep(wait)
+                continue
+            errors.setdefault(label, []).append(repr(e))
+            print("%s bench failed: %r" % (label, e), file=sys.stderr)
+            return None
 
 
 def _run(argv):
@@ -42,24 +78,13 @@ def main():
     # the median over >=5 windows carries its own error bar.
     os.environ.setdefault("PADDLE_TPU_BENCH_WINDOWS", "5")
 
-    _run(["--batch_size", "256", "--iterations", "20",
-          "--skip_batch_num", "3", "--device", "TPU",
-          "--dtype", "bfloat16"])
-    from resnet import main as resnet_main
-    ips = resnet_main()
-    baseline = 81.69
-    mfu = ips * FLOPS_PER_IMG_TRAIN / PEAK_BF16
-    print("ResNet-50 MFU %.1f%% (%.1f img/s)" % (mfu * 100, ips),
-          file=sys.stderr)
+    errors = {}
 
-    # fresh graph state for the second model (both mains build into the
-    # default program)
+    # every config (the headline included) builds into the default
+    # program, so every config — and every RETRY of one — starts from
+    # the one reset recipe
     import paddle_tpu as fluid
     from paddle_tpu.core import scope as scope_mod
-    fluid.switch_main_program(fluid.Program())
-    fluid.switch_startup_program(fluid.Program())
-    scope_mod._global_scope = scope_mod.Scope()
-    fluid.amp.enable_amp(False)
 
     def _fresh():
         fluid.switch_main_program(fluid.Program())
@@ -67,60 +92,73 @@ def main():
         scope_mod._global_scope = scope_mod.Scope()
         fluid.amp.enable_amp(False)
 
+    def _resnet_first():
+        _fresh()        # a retried attempt must not append a second
+        # ResNet into the program the failed attempt already built
+        _run(["--batch_size", "256", "--iterations", "20",
+              "--skip_batch_num", "3", "--device", "TPU",
+              "--dtype", "bfloat16"])
+        from resnet import main as resnet_main
+        return float(resnet_main())
+
+    ips = guarded("resnet", _resnet_first, errors)
+    baseline = 81.69
+    if ips is not None:
+        mfu = ips * FLOPS_PER_IMG_TRAIN / PEAK_BF16
+        print("ResNet-50 MFU %.1f%% (%.1f img/s)" % (mfu * 100, ips),
+              file=sys.stderr)
+
     import importlib
 
     def transformer_bench(label, bs, L=4, D=512, FFN=2048, T=256,
                           V=8192, heads=None):
         """One transformer config through benchmarks/transformer.py;
-        returns (tok/s, mfu) or (None, None) — ResNet stays the
+        returns tok/s or None (via guarded) — ResNet stays the
         headline even if a transformer config fails."""
-        _fresh()
-        argv = ["--batch_size", str(bs), "--iterations", "10",
-                "--skip_batch_num", "3", "--device", "TPU",
-                "--dtype", "bfloat16", "--n_layer", str(L),
-                "--d_model", str(D), "--d_inner", str(FFN),
-                "--max_len", str(T), "--vocab", str(V)]
-        if heads:
-            argv += ["--n_head", str(heads)]
-        _run(argv)
-        try:
+        def _one():
+            _fresh()
+            argv = ["--batch_size", str(bs), "--iterations", "10",
+                    "--skip_batch_num", "3", "--device", "TPU",
+                    "--dtype", "bfloat16", "--n_layer", str(L),
+                    "--d_model", str(D), "--d_inner", str(FFN),
+                    "--max_len", str(T), "--vocab", str(V)]
+            if heads:
+                argv += ["--n_head", str(heads)]
+            _run(argv)
             import transformer as tmod
             tps = float(importlib.reload(tmod).main())
-        except Exception as e:
-            print("%s bench failed: %s" % (label, e), file=sys.stderr)
-            return None, None
-        mfu = tps * flops_per_token(L, D, FFN, T, V) / PEAK_BF16
-        print("%s MFU %.1f%% (%.0f tok/s)" % (label, mfu * 100, tps),
-              file=sys.stderr)
-        return tps, mfu
+            mfu = tps * flops_per_token(L, D, FFN, T, V) / PEAK_BF16
+            print("%s MFU %.1f%% (%.0f tok/s)"
+                  % (label, mfu * 100, tps), file=sys.stderr)
+            return tps
+
+        return guarded(label, _one, errors)
 
     def resnet_repeat():
-        _fresh()
-        _run(["--batch_size", "256", "--iterations", "20",
-              "--skip_batch_num", "3", "--device", "TPU",
-              "--dtype", "bfloat16"])
-        import resnet as rmod
-        try:
+        def _one():
+            _fresh()
+            _run(["--batch_size", "256", "--iterations", "20",
+                  "--skip_batch_num", "3", "--device", "TPU",
+                  "--dtype", "bfloat16"])
+            import resnet as rmod
             return float(importlib.reload(rmod).main())
-        except Exception as e:
-            print("resnet repeat failed: %s" % e, file=sys.stderr)
-            return None
+
+        return guarded("resnet-repeat", _one, errors)
 
     def lstm_repeat():
         """The reference's strongest published training line: stacked
         dynamic LSTM (benchmark/README.md 184 ms/batch, h=512 bs=64 on
         a K40m) — the LoD/bucketing path under perf, not just
         correctness. Returns ms/batch (lower is better)."""
-        _fresh()
-        _run(["--batch_size", "64", "--hidden_dim", "512",
-              "--iterations", "12", "--skip_batch_num", "2",
-              "--device", "TPU"])
-        try:
+        def _one():
+            _fresh()
+            _run(["--batch_size", "64", "--hidden_dim", "512",
+                  "--iterations", "12", "--skip_batch_num", "2",
+                  "--device", "TPU"])
             import stacked_dynamic_lstm as lmod
             return float(importlib.reload(lmod).main())
-        except Exception as e:
-            print("lstm repeat failed: %s" % e, file=sys.stderr)
-            return None
+
+        return guarded("lstm", _one, errors)
 
     # INTERLEAVED repeats (VERDICT r4 #7): the tunnel drifts +-30%
     # across a session, so each config is measured K times spread across
@@ -136,16 +174,16 @@ def main():
             # bs256: the throughput-saturating batch for the 4L/d512
             # config — bs32 is dispatch-latency-bound (PERF.md batch
             # sweep); one sample (secondary metric)
-            tps_small, _ = transformer_bench("Transformer-small", bs=256)
+            tps_small = transformer_bench("Transformer-small", bs=256)
         # the LARGE config (8L d1024 ffn4096 T1024): kept unchanged for
         # round-over-round comparability
         large_s.append(transformer_bench(
-            "Transformer-large", bs=8, L=8, D=1024, FFN=4096, T=1024)[0])
+            "Transformer-large", bs=8, L=8, D=1024, FFN=4096, T=1024))
         # the XL config — the best honest MFU this chip reaches (width
         # sweep, PERF.md round 4): 8L d2048 ffn8192 T1024, head dim 128
         xl_s.append(transformer_bench(
             "Transformer-XL", bs=8, L=8, D=2048, FFN=8192, T=1024,
-            heads=16)[0])
+            heads=16))
         lstm_s.append(lstm_repeat())
 
     def monitor_probe():
@@ -165,15 +203,11 @@ def main():
         # monitor.session(): respects an env-armed ambient config and
         # reports the PROBE's own counts as deltas, so the stamp never
         # aggregates the headline windows' steps
-        try:
-            with mon.session(log_path=log) as sess:
-                _run(["--batch_size", "128", "--iterations", "10",
-                      "--skip_batch_num", "2", "--device", "TPU"])
-                import mnist as mmod
-                importlib.reload(mmod).main()
-        except Exception as e:
-            print("monitor probe failed: %s" % e, file=sys.stderr)
-            return None
+        with mon.session(log_path=log) as sess:
+            _run(["--batch_size", "128", "--iterations", "10",
+                  "--skip_batch_num", "2", "--device", "TPU"])
+            import mnist as mmod
+            importlib.reload(mmod).main()
         s = sess.summary()
         probe = {
             "steps": s["steps"],
@@ -188,25 +222,36 @@ def main():
         print("monitor probe: %s" % probe, file=sys.stderr)
         return probe
 
-    monitor_summary = monitor_probe()
+    monitor_summary = guarded("monitor-probe", monitor_probe, errors)
 
     def serving_probe():
         """Continuous-batching serving smoke (benchmarks/serving_bench
         fast CPU mode): engine-vs-sequential aggregate tokens/s on a
-        mixed-length request set, with token identity verified. Runs on
-        the CPU backend — the engine's win is scheduling, measured
-        without the tunnel's per-step sync tax — and is stamped into
-        the bench JSON like the monitor probe."""
-        _fresh()
-        _run(["--device", "CPU", "--fast"])
+        mixed-length request set, with token identity verified and the
+        request-level SLO percentiles (TTFT/TPOT p50/p95) stamped.
+        Runs on the CPU backend — the engine's win is scheduling,
+        measured without the tunnel's per-step sync tax — and is
+        stamped into the bench JSON like the monitor probe."""
+        import jax
+        prev = jax.config.jax_default_device
         try:
+            _fresh()
+            _run(["--device", "CPU", "--fast"])
             import serving_bench as smod
             return importlib.reload(smod).main()
-        except Exception as e:
-            print("serving probe failed: %s" % e, file=sys.stderr)
-            return None
+        finally:
+            # serving_bench pins the PROCESS default device to CPU for
+            # its engine thread and restores it itself; verify here
+            # too — a leaked CPU pin would silently steer every later
+            # config off the axon chip (BENCH_r05 post-mortem)
+            if jax.config.jax_default_device is not prev:
+                print("serving probe leaked jax_default_device=%r — "
+                      "restoring %r"
+                      % (jax.config.jax_default_device, prev),
+                      file=sys.stderr)
+                jax.config.update("jax_default_device", prev)
 
-    serving_summary = serving_probe()
+    serving_summary = guarded("serving-probe", serving_probe, errors)
 
     import statistics
 
@@ -219,7 +264,6 @@ def main():
         return med, round(spread, 1), [round(v, 1) for v in vals]
 
     ips, res_spread, res_samples = agg(res_s)
-    mfu = ips * FLOPS_PER_IMG_TRAIN / PEAK_BF16
     large_flops_tok = flops_per_token(L=8, D=1024, FFN=4096, T=1024,
                                       V=8192)
     xl_flops_tok = flops_per_token(L=8, D=2048, FFN=8192, T=1024, V=8192)
@@ -227,12 +271,16 @@ def main():
     tps_xl, xl_spread, xl_samples = agg(xl_s)
     lstm_ms, lstm_spread, lstm_samples = agg(lstm_s)
 
+    # the JSON stamps even when the headline failed every repeat: a
+    # null value + per-config errors beats an aborted, empty record
     out = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
-        "value": round(float(ips), 1),
+        "value": round(float(ips), 1) if ips is not None else None,
         "unit": "imgs/sec",
-        "vs_baseline": round(float(ips) / baseline, 2),
-        "mfu_pct": round(mfu * 100, 1),
+        "vs_baseline": round(float(ips) / baseline, 2)
+        if ips is not None else None,
+        "mfu_pct": round(ips * FLOPS_PER_IMG_TRAIN / PEAK_BF16 * 100, 1)
+        if ips is not None else None,
         "repeats": K,
         "spread_pct": res_spread,
         "samples": res_samples,
@@ -264,8 +312,13 @@ def main():
         out["monitor"] = monitor_summary
     if serving_summary is not None:
         # continuous-batching stamp (paddle_tpu.serving): engine vs
-        # sequential tokens/s, speedup, occupancy, token identity
+        # sequential tokens/s, speedup, occupancy, token identity,
+        # request-level SLO percentiles (TTFT/TPOT p50/p95)
         out["serving"] = serving_summary
+    if errors:
+        # per-config failures (after retries): the record names what
+        # was skipped instead of the whole round vanishing
+        out["errors"] = errors
     print(json.dumps(out))
 
 

@@ -5,8 +5,10 @@ tier-1 smoke): a mixed-length request set (random prompts, 16-128 new
 tokens) is decoded twice — once per-request sequentially (the jitted
 single-token bs1 loop, PERF.md's measured serving shape) and once
 through ``serving.Engine`` with ``--slots`` decode slots. Reports
-aggregate tokens/s for both, the speedup, slot occupancy, and verifies
-the engine output is TOKEN-IDENTICAL to the sequential baseline.
+aggregate tokens/s for both, the speedup, slot occupancy, the
+request-level SLO percentiles (TTFT/TPOT p50/p95, queue_wait p95 —
+from the Request handles' lifecycle attribution), and verifies the
+engine output is TOKEN-IDENTICAL to the sequential baseline.
 Prints one JSON line; ``main()`` returns the dict (bench.py stamps it).
 """
 
@@ -23,6 +25,7 @@ from paddle_tpu.models import transformer as T  # noqa: E402
 from paddle_tpu.models.transformer_infer import TransformerLMInfer  # noqa: E402
 from paddle_tpu import serving  # noqa: E402
 from paddle_tpu.monitor import runtime as monrt  # noqa: E402
+from paddle_tpu.monitor.recorder import percentile_sorted  # noqa: E402
 
 
 def build_requests(rng, n, vocab, max_prompt, min_new, max_new):
@@ -107,8 +110,10 @@ def _run_bench(args):
     total = sum(len(t) for t, _ in seq_out)
 
     t0 = time.perf_counter()
-    eng_out = eng.generate_many([p for p, _ in reqs],
-                                [m for _, m in reqs])
+    # submit + drain by hand (not generate_many): the Request handles
+    # carry the lifecycle attribution the SLO stamp below reads
+    handles = [eng.submit(p, m) for p, m in reqs]
+    eng_out = [h.result() for h in handles]
     eng_dt = time.perf_counter() - t0
     occupancy = eng.occupancy()
     eng.close()
@@ -130,6 +135,22 @@ def _run_bench(args):
         "slot_occupancy_gauge": monrt.SERVING_SLOT_OCCUPANCY.value(),
         "served_tokens_total": monrt.SERVING_TOKENS.value(),
     }
+
+    def _pct_ms(vals, q):
+        vals = sorted(v for v in vals if v is not None)
+        v = percentile_sorted(vals, q)
+        return None if v is None else round(1000.0 * v, 3)
+
+    ttft = [h.ttft for h in handles]
+    tpot = [h.tpot for h in handles]
+    qw = [h.queue_wait for h in handles]
+    # the request-level SLO figures (ISSUE 6): what a latency gate
+    # would bound on this host class
+    out["ttft_p50_ms"] = _pct_ms(ttft, 0.50)
+    out["ttft_p95_ms"] = _pct_ms(ttft, 0.95)
+    out["tpot_p50_ms"] = _pct_ms(tpot, 0.50)
+    out["tpot_p95_ms"] = _pct_ms(tpot, 0.95)
+    out["queue_wait_p95_ms"] = _pct_ms(qw, 0.95)
     # progress line on stderr; the stdout JSON stays the __main__ CLI's
     # (bench.py embeds the dict in ITS one JSON line instead)
     print("serving: engine %.0f tok/s vs sequential %.0f (%.2fx, "

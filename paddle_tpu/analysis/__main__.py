@@ -19,8 +19,10 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.resilience.retry",
     "paddle_tpu.resilience.driver",
     "paddle_tpu.monitor",
+    "paddle_tpu.monitor.watch",
     "paddle_tpu.serving",
     "paddle_tpu.serving.engine",
+    "paddle_tpu.slo",
     "paddle_tpu.trace",
     "paddle_tpu.trace.runtime",
     "paddle_tpu.trace.clock",

@@ -31,6 +31,9 @@ Registered flags:
                         feed buffers across same-signature run() calls
   serving*        —     paddle_tpu.serving continuous-batching engine
                         knobs (prefill chunk length, admission window)
+  slo_spec        str   default SLO spec JSON for python -m
+                        paddle_tpu.slo and the live verdict line of
+                        python -m paddle_tpu.monitor watch
 
 Distributed bootstrap envs (read by distributed.launch, not here):
   PADDLE_COORDINATOR, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID.
@@ -174,6 +177,11 @@ _register("serving_admission_wait", float, 0.0,
           "IDLE engine holds admissions up to this long for the queue "
           "to fill to the slot count before starting a sparse batch. "
           "0 = greedy fill (admit at the next step boundary)")
+_register("slo_spec", str, "",
+          "default SLO spec JSON path: python -m paddle_tpu.slo uses "
+          "it when no spec argument is given, and python -m "
+          "paddle_tpu.monitor watch renders a live verdict line "
+          "against it (see paddle_tpu/slo.py for the spec schema)")
 _register("fuse_conv_bn", bool, False,
           "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
           "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "

@@ -14,7 +14,11 @@ Quickstart::
     print(monitor.prometheus_text())
 
 or env-driven: ``PADDLE_TPU_MONITOR=1 PADDLE_TPU_MONITOR_LOG=run.jsonl``.
-Summarize a recorded log: ``python -m paddle_tpu.monitor run.jsonl``.
+Summarize a recorded log (training AND serving rows):
+``python -m paddle_tpu.monitor run.jsonl``. Live terminal dashboard
+over a (possibly still-writing) log — serving tokens/s, occupancy,
+rolling TTFT/TPOT percentiles, optional SLO verdict:
+``python -m paddle_tpu.monitor watch run.jsonl [--slo spec.json]``.
 """
 
 from .metrics import (Counter, Gauge, Histogram, Registry,  # noqa: F401
@@ -22,11 +26,13 @@ from .metrics import (Counter, Gauge, Histogram, Registry,  # noqa: F401
 from .recorder import (FlightRecorder, read_jsonl,  # noqa: F401
                        read_jsonl_tolerant)
 from .watchdog import Watchdog, thread_stacks  # noqa: F401
+from .watch import watch  # noqa: F401
 from .runtime import (  # noqa: F401
     enable, disable, enabled, recorder, set_peak_flops,
     set_tokens_per_step, on_compile, on_cache_hit, on_step, on_nan_trip,
     on_retry, on_reconnect, on_fault, on_rollback, on_resume,
-    on_checkpoint, on_serving_step, on_feed_plan, feed_nbytes,
+    on_checkpoint, on_serving_step, on_serving_request, on_feed_plan,
+    feed_nbytes,
     tokens_in_feeds, sync_every, step_timer, summary, session,
     prometheus_text, dump_metrics, maybe_enable_from_flags,
     reset_for_tests,

@@ -1,12 +1,17 @@
-"""CLI: summarize a flight-recorder JSONL log.
+"""CLI: summarize or live-watch a flight-recorder JSONL log.
 
     python -m paddle_tpu.monitor run.jsonl [--json]
+    python -m paddle_tpu.monitor watch run.jsonl [--interval S]
+        [--window N] [--once] [--slo spec.json]
 
-Prints run metadata, step count and latency percentiles, compile /
-recompile counts (with causes), NaN trips, stalls, and the derived
-throughput figures (mean MFU, tokens/s) the runtime stamped on each
-step event. `--json` emits the same summary as one JSON object for
-scripts (bench.py consumes this shape).
+The summary covers BOTH workloads a log may carry: training `step`
+rows (step count, latency percentiles, compile/recompile causes, MFU,
+tokens/s) and serving `serving_step`/`serving_request` rows (engine
+step p50/p95, occupancy, queue depth, TTFT/TPOT percentiles, error
+count) — one command reports whatever ran. `--json` emits the same
+summary as one JSON object for scripts (bench.py consumes this shape).
+`watch` tails a (possibly live) log and renders a refreshing terminal
+dashboard; `--once` renders a single frame and exits (scripts/tests).
 """
 
 import argparse
@@ -57,8 +62,44 @@ def summarize_log(path):
         "stalls": sum(1 for e in events if e["ev"] == "stall"),
         "truncated": any(e["ev"] == "truncated" for e in events),
         "skipped_lines": skipped,
+        "serving": _summarize_serving(events),
     }
     return out
+
+
+def _summarize_serving(events):
+    """Aggregate serving_step / serving_request rows (None when the
+    log carries neither — a pure training log stays unchanged). The
+    latency samples come from the SLO engine's ONE rows->samples
+    extraction (failed-request exclusion included), so this summary
+    and `python -m paddle_tpu.slo --log` always agree on a file."""
+    sstep = [e for e in events if e["ev"] == "serving_step"]
+    sreq = [e for e in events if e["ev"] == "serving_request"]
+    if not sstep and not sreq:
+        return None
+    from .. import slo as _slo
+    s = _slo.samples_from_events(events)
+    sdts = sorted(s["step_latency"])
+    ttft = sorted(s["ttft"])
+    tpot = sorted(s["tpot"])
+    qw = sorted(s["queue_wait"])
+    occ = [e["active"] / e["slots"] for e in sstep if e.get("slots")]
+    return {
+        "steps": len(sstep),
+        "step_p50_s": _percentile(sdts, 0.50),
+        "step_p95_s": _percentile(sdts, 0.95),
+        "mean_occupancy": (sum(occ) / len(occ)) if occ else None,
+        "max_queue_depth": max(
+            (e.get("queue_depth") or 0 for e in sstep), default=0),
+        "tokens": sum(e.get("emitted") or 0 for e in sstep),
+        "requests": s["requests"],
+        "errors": s["errors"],
+        "ttft_p50_s": _percentile(ttft, 0.50),
+        "ttft_p95_s": _percentile(ttft, 0.95),
+        "tpot_p50_s": _percentile(tpot, 0.50),
+        "tpot_p95_s": _percentile(tpot, 0.95),
+        "queue_wait_p95_s": _percentile(qw, 0.95),
+    }
 
 
 def _fmt_ms(v):
@@ -87,6 +128,26 @@ def render(s):
         lines.append("  MFU         %.1f%%" % (100 * s["mean_mfu"]))
     if s["mean_tokens_per_sec"] is not None:
         lines.append("  tokens/s    %.0f" % s["mean_tokens_per_sec"])
+    sv = s.get("serving")
+    if sv:
+        lines.append(
+            "  serving     %d step(s)  (p50 %s, p95 %s)  occupancy "
+            "%s  max queue %d  tokens %d" % (
+                sv["steps"], _fmt_ms(sv["step_p50_s"]),
+                _fmt_ms(sv["step_p95_s"]),
+                "n/a" if sv["mean_occupancy"] is None
+                else "%.2f" % sv["mean_occupancy"],
+                sv["max_queue_depth"], sv["tokens"]))
+        if sv["requests"]:
+            lines.append(
+                "  requests    %d  TTFT p50 %s p95 %s  TPOT p50 %s "
+                "p95 %s  queue_wait p95 %s%s" % (
+                    sv["requests"],
+                    _fmt_ms(sv["ttft_p50_s"]), _fmt_ms(sv["ttft_p95_s"]),
+                    _fmt_ms(sv["tpot_p50_s"]), _fmt_ms(sv["tpot_p95_s"]),
+                    _fmt_ms(sv["queue_wait_p95_s"]),
+                    "  ERRORS %d" % sv["errors"] if sv["errors"]
+                    else ""))
     if s["nan_trips"]:
         lines.append("  NaN trips   %d" % s["nan_trips"])
     if s["stalls"]:
@@ -97,10 +158,56 @@ def render(s):
     return "\n".join(lines)
 
 
+def _watch_main(argv):
+    from .watch import watch
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.monitor watch",
+        description="Tail a flight-recorder log and render a live "
+                    "terminal dashboard")
+    p.add_argument("log", help="flight-recorder .jsonl path")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between refreshes (default 2)")
+    p.add_argument("--window", type=int, default=256,
+                   help="rolling-window rows per series (default 256)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame from the current log "
+                        "contents and exit")
+    p.add_argument("--slo", default=None,
+                   help="SLO spec JSON evaluated live over the "
+                        "rolling request window (default: the "
+                        "PADDLE_TPU_SLO_SPEC flag when set)")
+    args = p.parse_args(argv)
+    slo_spec = args.slo
+    if slo_spec is None:
+        from .. import flags
+        slo_spec = flags.get_flag("slo_spec") or None
+    if slo_spec is not None:
+        # validate up front: a typo'd --slo path (or a bad flag-named
+        # spec) must be a clean exit 2, like the slo CLI, not a
+        # traceback out of the render loop
+        from .. import slo as _slo
+        try:
+            slo_spec = _slo.load_spec(slo_spec)
+        except (OSError, ValueError) as e:
+            print("watch: bad SLO spec %s: %s" % (args.slo or
+                                                  "(from flag)", e),
+                  file=sys.stderr)
+            return 2
+    frame = watch(args.log, interval=args.interval, window=args.window,
+                  once=args.once, slo_spec=slo_spec)
+    # --once on a log that does not exist is a scripting error (1);
+    # the live loop instead waits for the file and exits 0 on Ctrl-C
+    return 1 if args.once and frame is None else 0
+
+
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "watch":
+        return _watch_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.monitor",
-        description="Summarize a paddle_tpu.monitor flight-recorder log")
+        description="Summarize a paddle_tpu.monitor flight-recorder "
+                    "log (or `watch <log>` for a live dashboard)")
     p.add_argument("log", help="flight-recorder .jsonl path")
     p.add_argument("--json", action="store_true",
                    help="emit the summary as one JSON object")

@@ -19,13 +19,36 @@ import bisect
 import json
 import threading
 
-__all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry"]
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry",
+           "bucket_percentile"]
 
 # step latencies span ~100us (tiny CPU graphs) to minutes (first XLA
 # compile included in a run() call); exponential buckets, factor ~2.
 DEFAULT_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def bucket_percentile(buckets, counts, q):
+    """Bucket-interpolated q-quantile (0..1) over NON-cumulative
+    per-bucket counts (``len(buckets) + 1`` entries, overflow last);
+    None when empty. ONE algorithm shared by ``Histogram.percentile``
+    (live) and the SLO evaluator's offline snapshot math
+    (paddle_tpu/slo.py) — a fix to either must be a fix to both, or
+    --metrics verdicts drift from live percentiles."""
+    total = sum(counts)
+    if not total:
+        return None
+    target = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if acc + c >= target and c:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i] if i < len(buckets) else buckets[-1]
+            frac = (target - acc) / c
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        acc += c
+    return buckets[-1]
 
 
 def _label_key(label_names, labels):
@@ -163,18 +186,7 @@ class Histogram(_Metric):
             if not ent or not ent["count"]:
                 return None
             counts = list(ent["counts"])
-            total = ent["count"]
-        target = q * total
-        acc = 0.0
-        for i, c in enumerate(counts):
-            if acc + c >= target and c:
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i] if i < len(self.buckets) \
-                    else self.buckets[-1]
-                frac = (target - acc) / c
-                return lo + (hi - lo) * min(1.0, max(0.0, frac))
-            acc += c
-        return self.buckets[-1]
+        return bucket_percentile(self.buckets, counts, q)
 
     def snapshot(self):
         with self._lock:
@@ -252,15 +264,21 @@ class Registry:
 
     def snapshot(self):
         """{name: {"kind", "labels", "series": {"l1,l2": value}}} — the
-        JSON-able dump the flight recorder and watchdog embed."""
+        JSON-able dump the flight recorder and watchdog embed.
+        Histograms additionally carry their "buckets" boundaries so a
+        dumped snapshot stays percentile-evaluable offline (the SLO
+        engine's --metrics source)."""
         with self._lock:
             metrics = list(self._metrics.values())
         out = {}
         for m in metrics:
             series = {",".join(k): v for k, v in m.snapshot().items()}
-            out[m.name] = {"kind": m.kind,
-                           "labels": list(m.label_names),
-                           "series": series}
+            ent = {"kind": m.kind,
+                   "labels": list(m.label_names),
+                   "series": series}
+            if m.kind == "histogram":
+                ent["buckets"] = list(m.buckets)
+            out[m.name] = ent
         return out
 
     def render_prometheus(self):

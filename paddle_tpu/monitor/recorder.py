@@ -16,6 +16,12 @@ Event types written by the runtime:
   stall | note | truncated
 Event types written by the resilience tier (paddle_tpu.resilience):
   fault | retry | reconnect | rollback | resume | checkpoint
+Event types written by the serving tier (paddle_tpu.serving):
+  serving_step     one engine iteration (active/slots/queue_depth/
+                   emitted/admitted/retired/dt, ambient trace id)
+  serving_request  one request retired or failed (queue_wait/ttft/
+                   tpot/tokens/prefill_chunks/prompt_len, the
+                   REQUEST's trace id, error when failed)
 """
 
 import json
@@ -89,10 +95,22 @@ class FlightRecorder:
                 self._truncated_written = True
                 tr = json.dumps({"ts": time.time(), "ev": "truncated",
                                  "max_bytes": self.max_bytes})
-                self._f.write(tr + "\n")
+                try:
+                    self._f.write(tr + "\n")
+                except OSError:
+                    pass                 # see below: never throw
                 self._bytes += len(tr) + 1
                 return False
-            self._f.write(line + "\n")
+            try:
+                self._f.write(line + "\n")
+            except OSError:
+                # the never-throw contract covers the WRITE too: a full
+                # disk must degrade to a counted drop, not propagate
+                # into an engine loop / executor step and strand its
+                # callers (the serving scheduler pops finished requests
+                # before recording them)
+                self._dropped += 1
+                return False
             self._bytes += nb
             return True
 

@@ -35,8 +35,9 @@ __all__ = [
     "enable", "disable", "enabled", "recorder", "set_peak_flops",
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
     "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
-    "on_checkpoint", "on_serving_step", "on_feed_plan", "summary",
-    "session", "prometheus_text", "dump_metrics",
+    "on_checkpoint", "on_serving_step", "on_serving_request",
+    "on_feed_plan", "summary", "session", "prometheus_text",
+    "dump_metrics",
 ]
 
 _REG = _metrics.registry()
@@ -116,6 +117,29 @@ SERVING_ADMISSIONS = _REG.counter(
 SERVING_RETIREMENTS = _REG.counter(
     "ptpu_serving_retirements_total",
     "requests retired from a decode slot (EOS or max_new)")
+SERVING_FAILURES = _REG.counter(
+    "ptpu_serving_request_failures_total",
+    "requests failed (engine closed or loop death) — the SLO error "
+    "budget numerator")
+# request-level latency attribution (ISSUE 6): the three figures a
+# serving SLO is written against, observed once per request retirement
+SERVING_TTFT = _REG.histogram(
+    "ptpu_serving_ttft_seconds",
+    "request time-to-first-token (submit -> first decoded token)",
+    ("engine",))
+SERVING_TPOT = _REG.histogram(
+    "ptpu_serving_tpot_seconds",
+    "mean per-token decode latency after the first token", ("engine",))
+SERVING_QUEUE_WAIT = _REG.histogram(
+    "ptpu_serving_queue_wait_seconds",
+    "request wait from submit to decode-slot admission", ("engine",))
+SERVING_STEP_SECONDS = _REG.histogram(
+    "ptpu_serving_step_seconds",
+    "wall time of one engine iteration (prefill chunk + decode step; "
+    "the wait-for-batch admission window is policy, not latency, and "
+    "is excluded) — the serving analogue of ptpu_step_seconds, so an "
+    "SLO step_latency objective gates the SAME quantity from a "
+    "metrics snapshot as from the recorder rows", ("engine",))
 # feed-plan cache (core/executor): a normalization is the full per-call
 # feed re-marshal PERF.md round 5 measured; a plan hit skipped it
 FEED_NORMALIZATIONS = _REG.counter(
@@ -595,10 +619,11 @@ def on_checkpoint(step, path, mode):
 # -- serving hooks (paddle_tpu.serving continuous-batching engine) ---------
 
 def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
-                    retired=0, engine="engine"):
+                    retired=0, engine="engine", dt=None):
     """One engine iteration completed: gauges reflect the step, counters
     accumulate, and (recorder armed) a ``serving_step`` row lands with
-    the active trace id so the fleet timeline can join engine steps."""
+    the step wall time and the active trace id so the fleet timeline
+    can join engine steps."""
     SERVING_QUEUE_DEPTH.set(queue_depth)
     SERVING_SLOT_OCCUPANCY.set(active / slots if slots else 0.0)
     if emitted:
@@ -607,12 +632,50 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
         SERVING_ADMISSIONS.inc(admitted)
     if retired:
         SERVING_RETIREMENTS.inc(retired)
+    if dt is not None:
+        SERVING_STEP_SECONDS.observe(dt, engine=engine)
     rec = _S.rec
     if rec is not None:
         rec.record("serving_step", engine=engine, active=active,
                    slots=slots, queue_depth=queue_depth,
                    emitted=emitted, admitted=admitted, retired=retired,
-                   **_trace_extra())
+                   dt=dt, **_trace_extra())
+
+
+def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,
+                       tokens=0, prefill_chunks=0, prompt_len=0,
+                       trace_id=None, error=None):
+    """One request retired (or failed) — the request-level latency
+    attribution tier. Histograms observe unconditionally (requests are
+    rare next to decode steps, same discipline as the serving
+    counters); a ``serving_request`` recorder row lands when the flight
+    recorder is armed, carrying the REQUEST's trace id (not the ambient
+    step's) so the fleet timeline can join request lanes."""
+    if error is not None:
+        # failed requests are the ERROR BUDGET's business only: their
+        # retire stamp is the failure time (a kill/wedge gap, not
+        # decode pace), so observing them would fail latency
+        # objectives with shutdown artifacts. The recorder row below
+        # still carries the raw values for forensics.
+        SERVING_FAILURES.inc()
+    else:
+        if queue_wait is not None:
+            SERVING_QUEUE_WAIT.observe(queue_wait, engine=engine)
+        if ttft is not None:
+            SERVING_TTFT.observe(ttft, engine=engine)
+        if tpot is not None:
+            SERVING_TPOT.observe(tpot, engine=engine)
+    rec = _S.rec
+    if rec is not None:
+        row = {"engine": engine, "queue_wait": queue_wait, "ttft": ttft,
+               "tpot": tpot, "tokens": tokens,
+               "prefill_chunks": prefill_chunks,
+               "prompt_len": prompt_len}
+        if trace_id is not None:
+            row["trace"] = trace_id
+        if error is not None:
+            row["error"] = error
+        rec.record("serving_request", **row)
 
 
 def on_feed_plan(hit):

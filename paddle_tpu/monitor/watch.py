@@ -1,0 +1,259 @@
+"""Live terminal dashboard: tail a flight-recorder JSONL and render a
+refreshing one-screen view of the run — the operator's glass for a
+serving engine (tokens/s, occupancy, queue depth, rolling TTFT/TPOT
+percentiles) and for training (step p50/p95, tokens/s, MFU), with
+stall / NaN / truncation indicators and an optional live SLO verdict.
+
+    python -m paddle_tpu.monitor watch run.jsonl
+    python -m paddle_tpu.monitor watch run.jsonl --slo slo.json
+    python -m paddle_tpu.monitor watch run.jsonl --once   # one frame
+
+The tail is incremental (only new bytes are parsed per refresh) and
+tolerant: a torn trailing line — the writer is LIVE — is retried on
+the next refresh, never fatal. Rolling figures cover the last
+``--window`` rows of each kind; totals (steps, requests, stalls) cover
+the whole log.
+"""
+
+import collections
+import json
+import sys
+import time
+
+from .recorder import percentile_sorted as _pct
+
+__all__ = ["watch", "WatchState", "render_frame"]
+
+
+class _Tail:
+    """Incremental reader: each poll() returns the complete lines that
+    arrived since the last poll, holding a torn trailing fragment back
+    for the next round. Opens lazily — a live tail may be started
+    BEFORE the run creates its log; poll() returns None until the file
+    exists."""
+
+    def __init__(self, path):
+        self.path = path
+        self._f = None
+        self._buf = ""
+
+    def poll(self):
+        if self._f is None:
+            try:
+                self._f = open(self.path, "r")
+            except (FileNotFoundError, PermissionError):
+                return None             # not created yet: retry later
+        chunk = self._f.read()
+        if not chunk:
+            return []
+        self._buf += chunk
+        lines = self._buf.split("\n")
+        self._buf = lines.pop()         # "" on a complete final line
+        return [ln for ln in lines if ln.strip()]
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+
+
+class WatchState:
+    """Rolling aggregation over flight-recorder rows."""
+
+    def __init__(self, window=256):
+        self.window = int(window)
+        self.serving_steps = collections.deque(maxlen=self.window)
+        self.requests = collections.deque(maxlen=self.window)
+        self.train_steps = collections.deque(maxlen=self.window)
+        self.events = 0
+        self.skipped = 0
+        self.total_serving_steps = 0
+        self.total_requests = 0
+        self.total_errors = 0
+        self.total_train_steps = 0
+        self.stalls = 0
+        self.nan_trips = 0
+        self.truncated = False
+        self.platform = None
+        self.last_ts = None
+
+    def feed_line(self, line):
+        try:
+            e = json.loads(line)
+        except json.JSONDecodeError:
+            self.skipped += 1
+            return
+        if not isinstance(e, dict) or "ev" not in e:
+            self.skipped += 1
+            return
+        self.events += 1
+        if e.get("ts") is not None:
+            self.last_ts = e["ts"]
+        ev = e["ev"]
+        if ev == "serving_step":
+            self.total_serving_steps += 1
+            self.serving_steps.append(e)
+        elif ev == "serving_request":
+            self.total_requests += 1
+            if e.get("error"):
+                self.total_errors += 1
+            self.requests.append(e)
+        elif ev == "step":
+            self.total_train_steps += 1
+            self.train_steps.append(e)
+        elif ev == "stall":
+            self.stalls += 1
+        elif ev == "nan_guard":
+            self.nan_trips += 1
+        elif ev == "truncated":
+            self.truncated = True
+        elif ev == "devices":
+            self.platform = e.get("platform")
+
+    def request_samples(self):
+        """SLO-engine-shaped samples over the rolling request window
+        (what --slo evaluates live) — delegates to the slo module's
+        one rows->samples extraction."""
+        import itertools
+        from .. import slo as _slo
+        return _slo.samples_from_events(
+            itertools.chain(self.requests, self.serving_steps),
+            source="watch window")
+
+
+def _ms(v):
+    return "n/a" if v is None else "%.1fms" % (1000.0 * v)
+
+
+def _p(vals, q):
+    return _pct(sorted(vals), q) if vals else None
+
+
+def render_frame(state, path, slo_verdict=None, now=None):
+    """One frame of the dashboard as a string (the ``--once`` / test
+    surface; the live loop wraps it in an ANSI clear)."""
+    lines = ["paddle_tpu monitor watch — %s   %d events (%s)"
+             % (path, state.events, state.platform or "?")]
+    if state.last_ts is not None and now is not None:
+        age = max(0.0, now - state.last_ts)
+        lines[0] += "   last event %.1fs ago" % age
+
+    if state.serving_steps:
+        dts = [s["dt"] for s in state.serving_steps
+               if s.get("dt") is not None]
+        last = state.serving_steps[-1]
+        occ = (last["active"] / last["slots"]) if last.get("slots") \
+            else 0.0
+        tps = None
+        ts = [s["ts"] for s in state.serving_steps
+              if s.get("ts") is not None]
+        if len(ts) >= 2 and ts[-1] > ts[0]:
+            tok = sum(s.get("emitted") or 0
+                      for s in state.serving_steps)
+            tps = tok / (ts[-1] - ts[0])
+        lines.append(
+            "serving   steps %-7d tokens/s %-8s occupancy %-5.2f "
+            "queue %-4d step p50 %s p95 %s"
+            % (state.total_serving_steps,
+               "n/a" if tps is None else "%.0f" % tps, occ,
+               last.get("queue_depth", 0),
+               _ms(_p(dts, 0.50)), _ms(_p(dts, 0.95))))
+    if state.requests:
+        # failed rows are error-budget-only (same policy as the SLO
+        # engine — this line and the verdict line below must agree)
+        ok = [r for r in state.requests if not r.get("error")]
+        ttft = [r["ttft"] for r in ok if r.get("ttft") is not None]
+        tpot = [r["tpot"] for r in ok if r.get("tpot") is not None]
+        qw = [r["queue_wait"] for r in ok
+              if r.get("queue_wait") is not None]
+        lines.append(
+            "requests  n %-9d TTFT p50 %s p95 %s   TPOT p50 %s "
+            "p95 %s   queue_wait p95 %s"
+            % (state.total_requests,
+               _ms(_p(ttft, 0.50)), _ms(_p(ttft, 0.95)),
+               _ms(_p(tpot, 0.50)), _ms(_p(tpot, 0.95)),
+               _ms(_p(qw, 0.95))))
+    if state.train_steps:
+        dts = [s["dt"] for s in state.train_steps
+               if s.get("dt") is not None and s.get("synced", True)]
+        last = state.train_steps[-1]
+        extra = ""
+        if last.get("tokens_per_sec"):
+            extra += "   tok/s %.0f" % last["tokens_per_sec"]
+        if last.get("mfu"):
+            extra += "   mfu %.1f%%" % (100 * last["mfu"])
+        lines.append("train     steps %-7d p50 %s p95 %s%s"
+                     % (state.total_train_steps, _ms(_p(dts, 0.50)),
+                        _ms(_p(dts, 0.95)), extra))
+    health = "health    stalls %d   nan trips %d   errors %d" % (
+        state.stalls, state.nan_trips, state.total_errors)
+    if state.truncated:
+        health += "   [LOG TRUNCATED AT CAP]"
+    if state.skipped:
+        # complete-but-unparseable lines: permanently skipped (a TORN
+        # trailing line never reaches here — _Tail holds it back and
+        # retries it next refresh)
+        health += "   (%d corrupt line(s) skipped)" % state.skipped
+    lines.append(health)
+    if slo_verdict is not None:
+        status = " ".join(
+            "%s %s%s" % ("PASS" if r["pass"] else "FAIL", r["metric"],
+                         ("=" + _ms(r["measured"]))
+                         if r["measured"] is not None
+                         and r["metric"] != "error_rate" else "")
+            for r in slo_verdict["objectives"])
+        lines.append("slo       %s   %s"
+                     % ("PASS" if slo_verdict["pass"] else "FAIL",
+                        status))
+    return "\n".join(lines)
+
+
+def watch(path, interval=2.0, window=256, once=False, out=None,
+          slo_spec=None, max_frames=None):
+    """Tail ``path`` and render the dashboard every ``interval``
+    seconds until interrupted. ``once`` reads what is there now,
+    renders ONE frame without clearing the screen, and returns it
+    (tests and scripts). ``slo_spec`` (path/dict) adds a live verdict
+    line evaluated over the rolling request window. ``max_frames``
+    bounds the live loop (None = until Ctrl-C)."""
+    if out is None:
+        out = sys.stdout
+    spec = None
+    if slo_spec:
+        from .. import slo as _slo
+        spec = _slo.load_spec(slo_spec)
+    state = WatchState(window=window)
+    tail = _Tail(path)
+    frames = 0
+    try:
+        while True:
+            lines = tail.poll()
+            if lines is None:           # log not created yet
+                if once:
+                    out.write("watch: %s does not exist (yet)\n" % path)
+                    return None
+                out.write("\x1b[2J\x1b[Hwatch: waiting for %s ...\n"
+                          % path)
+                out.flush()
+                time.sleep(interval)
+                continue
+            for line in lines:
+                state.feed_line(line)
+            verdict = None
+            if spec is not None:
+                from .. import slo as _slo
+                verdict = _slo.evaluate(spec, state.request_samples())
+            frame = render_frame(state, path, slo_verdict=verdict,
+                                 now=None if once else time.time())
+            if once:
+                out.write(frame + "\n")
+                return frame
+            out.write("\x1b[2J\x1b[H" + frame + "\n")
+            out.flush()
+            frames += 1
+            if max_frames is not None and frames >= max_frames:
+                return frame
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return None
+    finally:
+        tail.close()

@@ -21,6 +21,15 @@ Quickstart::
 or the synchronous convenience ``eng.generate_many(prompts, 32)``.
 ``sequential_generate`` is the one-at-a-time baseline the engine is
 benchmarked (and token-identity-tested) against.
+
+Request-level observability (ISSUE 6): every ``Request`` handle
+carries its lifecycle attribution after retirement — ``queue_wait``,
+``ttft``, ``tpot``, ``prefill_chunks``, ``latency()`` — mirrored into
+``ptpu_serving_{ttft,tpot,queue_wait}_seconds`` histograms,
+``serving_request`` flight-recorder rows and ``serving.request`` trace
+spans. ``python -m paddle_tpu.slo`` gates a declarative SLO spec
+against any of those surfaces; ``python -m paddle_tpu.monitor watch``
+renders them live.
 """
 
 from .engine import (Engine, Request,  # noqa: F401

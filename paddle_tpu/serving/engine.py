@@ -21,11 +21,20 @@ Orca (iteration-level scheduling) and vLLM (slot/block-managed caches):
 
 Every engine iteration is instrumented: monitor gauges/counters
 (``ptpu_serving_*``), a ``serving_step`` flight-recorder row carrying
-the active trace id, and an ``engine.step`` trace span.
+the active trace id, and an ``engine.step`` trace span. Every REQUEST
+is instrumented too (the unit a user experiences, which Orca-style
+iteration scheduling makes a product of policy, not just kernel time):
+lifecycle stamps at enqueue/admit/first-token/retire on the ``Request``
+handle, derived queue_wait / TTFT / TPOT, a ``serving_request``
+recorder row + ``ptpu_serving_{ttft,tpot,queue_wait}_seconds``
+histograms at retirement, and a ``serving.request`` trace span (child
+spans per prefill chunk, a first-token mark, step-span links) so
+``trace merge`` shows request lanes across the fleet timeline.
 """
 
 import collections
 import threading
+import time
 
 import numpy as np
 import jax
@@ -42,10 +51,21 @@ class Request:
 
     ``result()`` blocks until the engine retires the request and returns
     ``(tokens, score)`` — the greedy continuation (EOS included when hit,
-    at most ``max_new`` tokens) and the sum of token log-probs."""
+    at most ``max_new`` tokens) and the sum of token log-probs.
+
+    Lifecycle attribution (ISSUE 6): the engine stamps four monotonic
+    (``time.perf_counter``) timestamps — ``t_enqueue`` (submit),
+    ``t_admit`` (decode-slot admission), ``t_first_token`` (first
+    decoded token lands), ``t_retire`` (EOS / max_new / failure) — and
+    the handle derives the three per-request latency figures a serving
+    SLO is written against: ``queue_wait``, ``ttft`` and ``tpot``.
+    Stamps later in the lifecycle are ``None`` until reached; reading
+    them after ``result()`` returns is race-free (the engine writes
+    them before resolving the future)."""
 
     __slots__ = ("prompt", "max_new", "tokens", "score", "_event",
-                 "_error")
+                 "_error", "t_enqueue", "t_admit", "t_first_token",
+                 "t_retire", "prefill_chunks", "_span")
 
     def __init__(self, prompt, max_new):
         self.prompt = [int(t) for t in prompt]
@@ -54,6 +74,50 @@ class Request:
         self.score = None
         self._event = threading.Event()
         self._error = None
+        self.t_enqueue = time.perf_counter()
+        self.t_admit = None
+        self.t_first_token = None
+        self.t_retire = None
+        self.prefill_chunks = 0
+        self._span = _trc.detached_span(
+            "serving.request", prompt_len=len(self.prompt),
+            max_new=self.max_new)
+        self._span.start()
+
+    @property
+    def queue_wait(self):
+        """Seconds from submit to decode-slot admission (None until
+        admitted)."""
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_enqueue
+
+    @property
+    def ttft(self):
+        """Time to first token: submit -> first decoded token (the
+        latency a streaming user perceives before output starts)."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_enqueue
+
+    @property
+    def tpot(self):
+        """Mean per-token decode latency AFTER the first token (the
+        steady streaming rate); 0.0 for single-token requests, None
+        until retired."""
+        if self.t_first_token is None or self.t_retire is None:
+            return None
+        n = len(self.tokens)
+        if n <= 1:
+            return 0.0
+        return (self.t_retire - self.t_first_token) / (n - 1)
+
+    def latency(self):
+        """The request's lifecycle attribution as one JSON-able dict
+        (what the ``serving_request`` recorder row carries)."""
+        return {"queue_wait": self.queue_wait, "ttft": self.ttft,
+                "tpot": self.tpot, "tokens": len(self.tokens),
+                "prefill_chunks": self.prefill_chunks}
 
     def _finish(self, score):
         self.score = score
@@ -143,7 +207,6 @@ class Engine:
             raise ValueError(
                 "prompt len %d + max_new %d exceeds model max_len %d"
                 % (len(prompt), max_new, self.model.max_len))
-        req = Request(prompt, max_new)
         with self._cv:
             if self._stop:
                 err = getattr(self, "_error", None)
@@ -151,6 +214,9 @@ class Engine:
                     raise RuntimeError(
                         "engine is closed (loop died: %r)" % (err,))
                 raise RuntimeError("engine is closed")
+            # construct after the closed-check: a rejected submit must
+            # not open a request span nobody will ever finish
+            req = Request(prompt, max_new)
             self._queue.append(req)
             self._cv.notify_all()
         return req
@@ -263,25 +329,93 @@ class Engine:
     def _step_once(self):
         """One engine iteration = admissions + one prefill chunk per
         prefilling slot + one decode step over the active batch."""
-        with _trc.span("engine.step") as sp:
-            admitted = self._admit()
-            self._advance_prefills()
-            active, finished = self._decode()
-            with self._cv:
-                depth = len(self._queue)
-            self.stats["steps"] += 1
-            self.stats["admissions"] += admitted
-            self.stats["retirements"] += len(finished)
-            sp.annotate(active=active, admitted=admitted,
-                        retired=len(finished), queue=depth)
-            _monrt.on_serving_step(
-                active=active, slots=self.slots, queue_depth=depth,
-                emitted=active, admitted=admitted,
-                retired=len(finished), engine=self.name)
-        # wake waiters LAST: a caller returning from result() must see
-        # this iteration's stats/metrics already landed
-        for req, score in finished:
-            req._finish(score)
+        finished = ()
+        try:
+            with _trc.span("engine.step") as sp:
+                admitted = self._admit()
+                # dt clock starts AFTER _admit: the deliberate
+                # wait-for-batch window (serving_admission_wait) is
+                # admission POLICY, and folding its idle sleep into
+                # step latency would fail a step_latency SLO for a
+                # batching knob the operator chose
+                t0 = time.perf_counter()
+                self._advance_prefills()
+                active, finished = self._decode()
+                with self._cv:
+                    depth = len(self._queue)
+                self.stats["steps"] += 1
+                self.stats["admissions"] += admitted
+                self.stats["retirements"] += len(finished)
+                dt = time.perf_counter() - t0
+                # the span's DURATION covers the whole iteration
+                # (admission wait included); the dt attr carries the
+                # same post-admit figure as the recorder row so the
+                # SLO --spans surface gates the same quantity as --log
+                sp.annotate(active=active, admitted=admitted,
+                            retired=len(finished), queue=depth, dt=dt)
+                _monrt.on_serving_step(
+                    active=active, slots=self.slots, queue_depth=depth,
+                    emitted=active, admitted=admitted,
+                    retired=len(finished), engine=self.name, dt=dt)
+                for req, _ in finished:
+                    self._retire_telemetry(req)
+        finally:
+            # wake waiters LAST: a caller returning from result() must
+            # see this iteration's stats/metrics/lifecycle stamps
+            # already landed. finally: a request popped from its slot
+            # by _decode is in `finished` ONLY — if instrumentation
+            # throws (e.g. a full disk under an armed recorder),
+            # _fail_all can no longer see it, so its future MUST
+            # resolve here or result() blocks forever.
+            for req, score in finished:
+                req._finish(score)
+
+    def _retire_telemetry(self, req, error=None):
+        """Per-request attribution at retirement: TTFT/TPOT/queue_wait
+        histograms + a ``serving_request`` recorder row + the request
+        span closed with the same figures annotated. Never raises —
+        attribution is telemetry, and an exception here (mid-loop in
+        _step_once or _fail_all) would strand the remaining requests'
+        futures."""
+        try:
+            lat = req.latency()
+            ctx = req._span.ctx
+            _monrt.on_serving_request(
+                engine=self.name, queue_wait=lat["queue_wait"],
+                ttft=lat["ttft"],
+                # a single-token request has NO inter-token interval:
+                # its handle reports tpot 0.0 (documented), but 0.0 in
+                # the histogram/samples would drag TPOT percentiles
+                # toward a rate that was never measured
+                tpot=lat["tpot"] if lat["tokens"] > 1 else None,
+                tokens=lat["tokens"],
+                prefill_chunks=lat["prefill_chunks"],
+                prompt_len=len(req.prompt),
+                trace_id=(ctx.trace_id
+                          if ctx is not None and ctx.sampled else None),
+                error=None if error is None else repr(error))
+            req._span.annotate(
+                **{k: v for k, v in lat.items() if v is not None})
+        except Exception:
+            pass
+        try:
+            req._span.finish(error=error)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _step_span_id():
+        """The ambient engine.step span id (loop thread), or None —
+        stamped on request child spans so the merged timeline can join
+        a request's lane to the engine iterations that drove it.
+        Mirrors the sampled check _retire_telemetry does for the trace
+        id: an UNSAMPLED step span is never written to the span log,
+        and a dangling join reference would be worse than none."""
+        cur = _trc.current_span()
+        ctx = getattr(cur, "ctx", None)
+        if ctx is None or not ctx.sampled:
+            return None
+        return ctx.span_id
 
     def _admit(self):
         admitted = 0
@@ -299,7 +433,12 @@ class Engine:
                 if not self._queue:
                     break
                 if self._recs[slot] is None:
-                    self._recs[slot] = {"req": self._queue.popleft(),
+                    req = self._queue.popleft()
+                    req.t_admit = time.perf_counter()
+                    req._span.annotate(slot=slot,
+                                       queue_wait=req.queue_wait,
+                                       admit_step=self._step_span_id())
+                    self._recs[slot] = {"req": req,
                                         "cursor": 0, "live": False}
                     admitted += 1
         return admitted
@@ -319,10 +458,15 @@ class Engine:
                 toks = req.prompt[cur:min(cur + self._chunk, need)]
                 chunk = np.zeros((self._chunk,), np.int32)
                 chunk[:len(toks)] = toks
-                self._state = self._prefill_fn(
-                    self._state, np.int32(slot), chunk, np.int32(cur),
-                    np.int32(len(toks)))
+                with _trc.child_span(
+                        "request.prefill_chunk", req._span, start=cur,
+                        tokens=len(toks),
+                        step_span=self._step_span_id()):
+                    self._state = self._prefill_fn(
+                        self._state, np.int32(slot), chunk,
+                        np.int32(cur), np.int32(len(toks)))
                 rec["cursor"] = cur + len(toks)
+                req.prefill_chunks += 1
                 self.stats["prefill_chunks"] += 1
             if rec["cursor"] >= need:
                 self._state = self._activate_fn(
@@ -340,13 +484,31 @@ class Engine:
         emit, fin = np.asarray(emit), np.asarray(fin)
         scores = None
         finished = []
+        now = time.perf_counter()
         for slot in live:
             rec = self._recs[slot]
-            rec["req"].tokens.append(int(emit[slot]))
+            req = rec["req"]
+            req.tokens.append(int(emit[slot]))
+            if req.t_first_token is None:
+                req.t_first_token = now
+                try:
+                    # guarded: by this point in the loop EARLIER slots
+                    # may already be popped into the local `finished`
+                    # — an exception escaping here (span-log write)
+                    # would lose them to both _step_once's finally and
+                    # _fail_all, stranding their result() forever
+                    with _trc.child_span(
+                            "request.first_token", req._span,
+                            step_span=self._step_span_id()):
+                        pass            # zero-width timeline mark
+                    req._span.annotate(ttft=req.ttft)
+                except Exception:
+                    pass
             if fin[slot]:
+                req.t_retire = now
                 if scores is None:      # one [S] fetch per iteration
                     scores = np.asarray(self._state["score"])
-                finished.append((rec["req"], float(scores[slot])))
+                finished.append((req, float(scores[slot])))
                 self._recs[slot] = None
         self.stats["decode_steps"] += 1
         self.stats["active_slot_steps"] += len(live)
@@ -360,6 +522,12 @@ class Engine:
             self._queue.clear()
             self._recs = [None] * self.slots
         for req in pending:
+            # failed requests still retire for attribution purposes:
+            # their row/span carries the error, and the SLO error
+            # budget counts them
+            if req.t_retire is None:
+                req.t_retire = time.perf_counter()
+            self._retire_telemetry(req, error=err)
             req._fail(err)
 
 

@@ -1,0 +1,365 @@
+"""Declarative serving-SLO evaluation: spec in, verdict out.
+
+The gate primitive of ROADMAP direction 2 ("latency SLOs measured from
+merged trace timelines"): a JSON spec declares objectives over the
+request-level figures the serving engine attributes per request
+(queue_wait, TTFT, TPOT — serving/engine.py lifecycle stamps), plus
+engine step latency and an error budget, and this module evaluates the
+spec against any of the three observability surfaces the runtime
+already produces:
+
+  * a monitor flight-recorder JSONL (``serving_request`` /
+    ``serving_step`` rows — EXACT per-request samples),
+  * trace span logs (``serving.request`` spans whose close-time attrs
+    carry the same figures — the merged-fleet-timeline source: pass
+    every process's span log and the verdict covers the fleet),
+  * a metrics snapshot (``monitor.dump_metrics(...json)`` registry
+    dump — ``ptpu_serving_*_seconds`` histogram buckets, APPROXIMATE
+    bucket-interpolated percentiles).
+
+Spec schema (JSON)::
+
+    {
+      "name": "chat-serving",
+      "objectives": [
+        {"metric": "ttft",        "percentile": 0.95, "max_seconds": 0.5},
+        {"metric": "tpot",        "percentile": 0.99, "max_seconds": 0.05},
+        {"metric": "queue_wait",  "percentile": 0.95, "max_seconds": 0.25},
+        {"metric": "step_latency","percentile": 0.95, "max_seconds": 0.1},
+        {"metric": "error_rate",  "max_ratio": 0.001}
+      ]
+    }
+
+An objective with NO samples fails (a run that measured nothing cannot
+claim an SLO was met) and says so in its reason. CLI::
+
+    python -m paddle_tpu.slo spec.json --log run.jsonl [--json]
+    python -m paddle_tpu.slo spec.json --spans *.jsonl
+    python -m paddle_tpu.slo spec.json --metrics metrics.json
+
+Exit code 0 = every objective passed, 1 = any failed (the CI/chaos
+gate contract), 2 = usage or spec error.
+"""
+
+import argparse
+import json
+import sys
+
+from .monitor.metrics import bucket_percentile as _hist_percentile
+from .monitor.recorder import percentile_sorted as _pct
+from .monitor.recorder import read_jsonl_tolerant
+
+__all__ = [
+    "load_spec", "evaluate", "samples_from_events",
+    "samples_from_monitor_log", "samples_from_span_logs",
+    "samples_from_metrics", "render", "main", "LATENCY_METRICS",
+]
+
+# objective metric -> metrics-snapshot histogram. step_latency is the
+# ENGINE iteration time on every surface (serving_step dt rows,
+# engine.step span durations, ptpu_serving_step_seconds buckets) — the
+# training executor's ptpu_step_seconds is a different quantity and is
+# deliberately not consulted.
+LATENCY_METRICS = {
+    "ttft": "ptpu_serving_ttft_seconds",
+    "tpot": "ptpu_serving_tpot_seconds",
+    "queue_wait": "ptpu_serving_queue_wait_seconds",
+    "step_latency": "ptpu_serving_step_seconds",
+}
+
+
+def load_spec(source):
+    """Parse + validate a spec (path, JSON string, or dict). Raises
+    ValueError on schema violations — a malformed gate spec must fail
+    LOUDLY (exit 2), never evaluate to a hollow pass."""
+    if isinstance(source, dict):
+        spec = source
+    else:
+        text = source
+        if not str(source).lstrip().startswith("{"):
+            with open(source) as f:
+                text = f.read()
+        spec = json.loads(text)
+    objectives = spec.get("objectives")
+    if not isinstance(objectives, list) or not objectives:
+        raise ValueError("SLO spec needs a non-empty 'objectives' list")
+    for i, obj in enumerate(objectives):
+        metric = obj.get("metric")
+        if metric == "error_rate":
+            if not isinstance(obj.get("max_ratio"), (int, float)):
+                raise ValueError(
+                    "objective %d (error_rate) needs numeric "
+                    "'max_ratio'" % i)
+        elif metric in LATENCY_METRICS:
+            if not isinstance(obj.get("max_seconds"), (int, float)):
+                raise ValueError(
+                    "objective %d (%s) needs numeric 'max_seconds'"
+                    % (i, metric))
+            q = obj.get("percentile", 0.95)
+            if not (0.0 < float(q) <= 1.0):
+                raise ValueError(
+                    "objective %d percentile %r outside (0, 1]"
+                    % (i, q))
+        else:
+            raise ValueError(
+                "objective %d names unknown metric %r (known: %s, "
+                "error_rate)" % (i, metric,
+                                 ", ".join(sorted(LATENCY_METRICS))))
+    return spec
+
+
+# -- sample extraction (one function per observability surface) ------------
+
+def _empty_samples(source):
+    return {"source": source, "requests": 0, "errors": 0,
+            "ttft": [], "tpot": [], "queue_wait": [],
+            "step_latency": [], "histograms": {}, "skipped": 0}
+
+
+def samples_from_events(events, source="events"):
+    """Exact per-request samples from an iterable of flight-recorder
+    event dicts (``serving_request`` rows + ``serving_step`` dt) — the
+    ONE rows->samples extraction, shared by the monitor-log surface
+    below and the watch dashboard's rolling-window verdict."""
+    out = _empty_samples(source)
+    for e in events:
+        ev = e.get("ev")
+        if ev == "serving_request":
+            out["requests"] += 1
+            if e.get("error"):
+                # error-budget business only: a failed request's retire
+                # stamp is the failure time (kill/wedge gap), and its
+                # latencies would fail percentile objectives with
+                # shutdown artifacts the error_rate already counts
+                out["errors"] += 1
+                continue
+            for k in ("ttft", "tpot", "queue_wait"):
+                if e.get(k) is not None:
+                    out[k].append(float(e[k]))
+        elif ev == "serving_step" and e.get("dt") is not None:
+            out["step_latency"].append(float(e["dt"]))
+    return out
+
+
+def samples_from_monitor_log(path):
+    """Exact per-request samples from ``serving_request`` rows (+
+    ``serving_step`` dt for step_latency) of one flight-recorder log."""
+    events, skipped = read_jsonl_tolerant(path)
+    out = samples_from_events(events, "monitor log %s" % path)
+    out["skipped"] = skipped
+    return out
+
+
+def samples_from_span_logs(paths):
+    """Per-request samples from ``serving.request`` spans (their
+    close-time attrs carry queue_wait/ttft/tpot) + ``engine.step`` span
+    durations, across every span log of a fleet — the merged-timeline
+    evaluation surface."""
+    out = _empty_samples("span logs %s" % ", ".join(paths))
+    for path in paths:
+        events, skipped = read_jsonl_tolerant(path)
+        out["skipped"] += skipped
+        for e in events:
+            if e.get("ev") != "span":
+                continue
+            attrs = e.get("attrs") or {}
+            if e.get("name") == "serving.request":
+                out["requests"] += 1
+                if attrs.get("error"):
+                    out["errors"] += 1   # latencies excluded, as above
+                    continue
+                for k in ("ttft", "tpot", "queue_wait"):
+                    if attrs.get(k) is not None:
+                        out[k].append(float(attrs[k]))
+            elif e.get("name") == "engine.step":
+                # the dt attr is the post-admission step time (same
+                # quantity as the serving_step row / histogram); the
+                # span DURATION also contains the wait-for-batch idle
+                # window and is only the fallback for older logs
+                out["step_latency"].append(
+                    float(attrs.get("dt", e["dur"])))
+    return out
+
+
+def samples_from_metrics(source):
+    """Approximate evaluation surface from a registry snapshot —
+    ``monitor.dump_metrics('m.json')`` output (or the dict
+    ``registry().snapshot()`` returns live). Histogram series merge
+    across labels; percentiles interpolate inside buckets."""
+    if isinstance(source, dict):
+        snap, label = source, "metrics snapshot"
+    else:
+        with open(source) as f:
+            snap = json.load(f)
+        label = "metrics snapshot %s" % source
+    out = _empty_samples(label)
+    for key, hist_name in LATENCY_METRICS.items():
+        ent = snap.get(hist_name)
+        if not ent or ent.get("kind") != "histogram" \
+                or "buckets" not in ent:
+            continue
+        buckets = [float(b) for b in ent["buckets"]]
+        counts = [0] * (len(buckets) + 1)
+        for series in ent["series"].values():
+            for i, c in enumerate(series.get("counts", ())):
+                if i < len(counts):
+                    counts[i] += int(c)
+        if sum(counts):
+            out["histograms"][key] = (buckets, counts)
+
+    def _counter_total(name):
+        ent = snap.get(name) or {}
+        series = ent.get("series") or {}
+        return sum(int(v) for v in series.values()) \
+            if ent.get("kind") == "counter" else 0
+
+    failures = _counter_total("ptpu_serving_request_failures_total")
+    out["errors"] = failures
+    out["requests"] = \
+        _counter_total("ptpu_serving_retirements_total") + failures
+    return out
+
+
+# -- evaluation ------------------------------------------------------------
+
+def evaluate(spec, samples):
+    """-> verdict dict: {"name", "pass", "source", "requests",
+    "errors", "objectives": [{metric, percentile?, threshold,
+    measured, count, approximate, pass, reason?}]}. Pure function of
+    (validated spec, samples) — the CLI and any CI/chaos gate share
+    it."""
+    spec = load_spec(spec)
+    results = []
+    for obj in spec["objectives"]:
+        metric = obj["metric"]
+        if metric == "error_rate":
+            threshold = float(obj["max_ratio"])
+            n = samples.get("requests", 0)
+            measured = (samples.get("errors", 0) / n) if n else None
+            ent = {"metric": metric, "threshold": threshold,
+                   "measured": measured, "count": n,
+                   "approximate": False}
+            if measured is None:
+                ent.update({"pass": False,
+                            "reason": "no requests observed"})
+            else:
+                ent["pass"] = measured <= threshold
+        else:
+            q = float(obj.get("percentile", 0.95))
+            threshold = float(obj["max_seconds"])
+            vals = sorted(samples.get(metric) or ())
+            approx = False
+            if vals:
+                measured, count = _pct(vals, q), len(vals)
+            else:
+                hist = (samples.get("histograms") or {}).get(metric)
+                if hist is not None:
+                    measured = _hist_percentile(hist[0], hist[1], q)
+                    count = sum(hist[1])
+                    approx = True
+                else:
+                    measured, count = None, 0
+            ent = {"metric": metric, "percentile": q,
+                   "threshold": threshold, "measured": measured,
+                   "count": count, "approximate": approx}
+            if measured is None:
+                ent.update({"pass": False,
+                            "reason": "no samples observed"})
+            else:
+                ent["pass"] = measured <= threshold
+        if obj.get("name"):
+            ent["name"] = obj["name"]
+        results.append(ent)
+    return {"name": spec.get("name"),
+            "pass": all(r["pass"] for r in results),
+            "source": samples.get("source"),
+            "requests": samples.get("requests", 0),
+            "errors": samples.get("errors", 0),
+            "skipped_lines": samples.get("skipped", 0),
+            "objectives": results}
+
+
+def _fmt(metric, v):
+    if v is None:
+        return "n/a"
+    if metric == "error_rate":
+        return "%.2f%%" % (100.0 * v)
+    return "%.2fms" % (1000.0 * v)
+
+
+def render(verdict):
+    head = "SLO %s: %s  (%s; %d request(s), %d error(s))" % (
+        verdict.get("name") or "<unnamed>",
+        "PASS" if verdict["pass"] else "FAIL",
+        verdict.get("source") or "?", verdict["requests"],
+        verdict["errors"])
+    lines = [head]
+    for r in verdict["objectives"]:
+        label = r["metric"]
+        if "percentile" in r:
+            label += " p%g" % (100.0 * r["percentile"])
+        line = "  %-4s %-18s %9s <= %-9s (n=%d%s)" % (
+            "PASS" if r["pass"] else "FAIL", label,
+            _fmt(r["metric"], r["measured"]),
+            _fmt(r["metric"], r["threshold"]), r["count"],
+            ", approx" if r.get("approximate") else "")
+        if r.get("reason"):
+            line += "  [%s]" % r["reason"]
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.slo",
+        description="Evaluate a serving SLO spec against recorded "
+                    "telemetry; exit 0 = pass, 1 = fail")
+    p.add_argument("spec", nargs="?", default=None,
+                   help="SLO spec JSON path (default: the "
+                        "PADDLE_TPU_SLO_SPEC flag)")
+    p.add_argument("--log", help="monitor flight-recorder .jsonl")
+    p.add_argument("--spans", nargs="+",
+                   help="trace span-log .jsonl file(s) — the merged "
+                        "fleet-timeline surface")
+    p.add_argument("--metrics",
+                   help="metrics snapshot .json "
+                        "(monitor.dump_metrics output; approximate)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the verdict as one JSON object")
+    args = p.parse_args(argv)
+
+    spec_path = args.spec
+    if not spec_path:
+        from . import flags
+        spec_path = flags.get_flag("slo_spec")
+    if not spec_path:
+        p.error("no spec: pass one or set PADDLE_TPU_SLO_SPEC")
+    sources = [s for s in (args.log, args.spans, args.metrics)
+               if s is not None]
+    if len(sources) != 1:
+        p.error("exactly one of --log / --spans / --metrics required")
+
+    try:
+        spec = load_spec(spec_path)
+    except (OSError, ValueError) as e:
+        print("paddle_tpu.slo: bad spec %s: %s" % (spec_path, e),
+              file=sys.stderr)
+        return 2
+    try:
+        if args.log:
+            samples = samples_from_monitor_log(args.log)
+        elif args.spans:
+            samples = samples_from_span_logs(args.spans)
+        else:
+            samples = samples_from_metrics(args.metrics)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("paddle_tpu.slo: unreadable telemetry: %s" % e,
+              file=sys.stderr)
+        return 2
+    verdict = evaluate(spec, samples)
+    print(json.dumps(verdict) if args.json else render(verdict))
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,8 +27,8 @@ site is a single is-None check (same bar as resilience.faults).
 """
 
 from .runtime import (  # noqa: F401
-    Span, SpanContext, Tracer, active_trace_id, annotate, current_span,
-    disable, enable, enabled, extract, maybe_enable_from_flags, span,
-    tracer,
+    Span, SpanContext, Tracer, active_trace_id, annotate, child_span,
+    current_span, detached_span, disable, enable, enabled, extract,
+    maybe_enable_from_flags, span, tracer,
 )
 from .clock import midpoint_offset, probe  # noqa: F401

@@ -44,7 +44,7 @@ from ..monitor.recorder import FlightRecorder
 __all__ = [
     "SpanContext", "Span", "Tracer", "enable", "disable", "enabled",
     "tracer", "span", "annotate", "current_span", "active_trace_id",
-    "extract", "maybe_enable_from_flags",
+    "extract", "maybe_enable_from_flags", "detached_span", "child_span",
 ]
 
 _DEFAULT_MAX_BYTES = 64 << 20
@@ -117,6 +117,19 @@ class Span:
     def annotate(self, **attrs):
         self.attrs.update(attrs)
 
+    def start(self):
+        """Explicit begin for spans whose lifetime cannot be a ``with``
+        block (the serving request span opens at submit() on the caller
+        thread and closes at retirement on the engine loop thread)."""
+        return self.__enter__()
+
+    def finish(self, error=None):
+        """Explicit end pairing ``start()``; ``error`` lands in attrs
+        the way an in-block exception would."""
+        if error is not None:
+            self.attrs["error"] = repr(error)
+        return self.__exit__(None, None, None)
+
     def __enter__(self):
         self.t0 = time.time()
         self._pc0 = time.perf_counter()
@@ -146,6 +159,12 @@ class _NullSpan:
 
     def annotate(self, **attrs):
         pass
+
+    def start(self):
+        return self
+
+    def finish(self, error=None):
+        return False
 
     def __enter__(self):
         return self
@@ -318,6 +337,35 @@ def span(name, **attrs):
     if t is None:
         return _NULL_SPAN
     return t.span(name, **attrs)
+
+
+def detached_span(name, **attrs):
+    """A new ROOT span that is neither entered nor ambient: the caller
+    owns its lifetime via ``start()``/``finish()``. This is the shape
+    for operations that cross engine iterations AND threads — the
+    serving request span opens at submit() on the caller thread and
+    closes at retirement on the engine loop thread, where an ambient
+    ``with`` block cannot reach. Head-sampled per the tracer rate like
+    any root; a no-op when disarmed."""
+    t = _TRACER
+    if t is None:
+        return _NULL_SPAN
+    sampled = (t.sample_rate >= 1.0 or t._rng.random() < t.sample_rate)
+    return Span(t, SpanContext(_new_id(), _new_id(), sampled=sampled),
+                name, dict(attrs), ambient=False)
+
+
+def child_span(name, parent, **attrs):
+    """Non-ambient child of an EXPLICIT parent span (which may live on
+    another thread's stack, or on no stack at all) — the per-prefill-
+    chunk and first-token spans under a serving request span. No-op
+    when disarmed, when the parent is a no-op, or when the parent was
+    sampled out."""
+    t = _TRACER
+    ctx = getattr(parent, "ctx", None)
+    if t is None or ctx is None or not ctx.sampled:
+        return _NULL_SPAN
+    return Span(t, ctx.child(), name, dict(attrs), ambient=False)
 
 
 def annotate(**attrs):

@@ -54,10 +54,11 @@ def lm():
 
 
 @pytest.fixture(scope="module")
-def eng3(lm):
-    """The shared slots=3 engine (one compile of step/prefill/activate
-    for the whole module)."""
-    eng = serving.Engine(lm, slots=3, prefill_chunk=4)
+def eng4(lm):
+    """The shared slots=4 engine (one compile of step/prefill/activate
+    for the whole module — slots=4 is also the ISSUE-6 acceptance
+    shape, so the lifecycle test rides the same compile)."""
+    eng = serving.Engine(lm, slots=4, prefill_chunk=4)
     yield eng
     eng.close()
 
@@ -79,33 +80,33 @@ def _assert_identical(seq, eng):
 
 # -- decode equivalence ----------------------------------------------------
 
-def test_engine_token_identical_with_slot_recycling(rng, lm, eng3):
-    """8 mixed-length requests through 3 slots: every slot retires and
+def test_engine_token_identical_with_slot_recycling(rng, lm, eng4):
+    """8 mixed-length requests through 4 slots: every slot retires and
     refills mid-flight (recycling), prompts longer than the prefill
     chunk exercise chunked prefill, and the outputs must be
     token-identical to the sequential one-at-a-time baseline."""
     reqs = _requests(rng, 8)
     assert max(len(p) for p, _ in reqs) > 4   # multi-chunk prefill real
     seq = serving.sequential_generate(lm, reqs)
-    r0, a0 = eng3.stats["retirements"], eng3.stats["admissions"]
-    out = eng3.generate_many([p for p, _ in reqs], [m for _, m in reqs])
-    assert eng3.stats["retirements"] - r0 == len(reqs)
-    assert eng3.stats["admissions"] - a0 == len(reqs)
-    assert eng3.occupancy() > 0.5
+    r0, a0 = eng4.stats["retirements"], eng4.stats["admissions"]
+    out = eng4.generate_many([p for p, _ in reqs], [m for _, m in reqs])
+    assert eng4.stats["retirements"] - r0 == len(reqs)
+    assert eng4.stats["admissions"] - a0 == len(reqs)
+    assert eng4.occupancy() > 0.5
     _assert_identical(seq, out)
 
 
-def test_engine_token_identical_mid_flight_admission(rng, lm, eng3):
+def test_engine_token_identical_mid_flight_admission(rng, lm, eng4):
     """Requests submitted WHILE the engine is decoding others join at a
     step boundary and still decode identically — admission timing must
     never leak into another slot's tokens."""
     reqs = _requests(rng, 5, min_new=10, max_new=18)
     seq = serving.sequential_generate(lm, reqs)
-    first = [eng3.submit(p, m) for p, m in reqs[:3]]
+    first = [eng4.submit(p, m) for p, m in reqs[:3]]
     time.sleep(0.03)          # let the first batch get mid-flight
-    rest = [eng3.submit(p, m) for p, m in reqs[3:]]
+    rest = [eng4.submit(p, m) for p, m in reqs[3:]]
     # both result surfaces: engine-level and the Request handle itself
-    out = [eng3.result(r, timeout=60) for r in first]
+    out = [eng4.result(r, timeout=60) for r in first]
     out += [r.result(timeout=60) for r in rest]
     _assert_identical(seq, out)
 
@@ -142,15 +143,16 @@ def test_engine_bf16_serving_mode(rng):
     _assert_identical(seq, out)
 
 
-def test_engine_validation_and_close(lm, eng3):
+def test_engine_validation_and_close(lm, eng4):
     with pytest.raises(ValueError, match="max_len"):
-        eng3.submit([1] * 10, MAX_LEN)          # 10 + L - 1 > L
+        eng4.submit([1] * 10, MAX_LEN)          # 10 + L - 1 > L
     with pytest.raises(ValueError, match="max_new"):
-        eng3.submit([1], 0)
+        eng4.submit([1], 0)
     with pytest.raises(ValueError):
         serving.Engine(lm, slots=0)
     # close() fails queued/in-flight requests loudly instead of hanging
     # (jit functions compile lazily, so this throwaway engine is cheap)
+    f0 = monrt.SERVING_FAILURES.value()
     eng = serving.Engine(lm, slots=1)
     eng.submit([1], 40)
     r2 = eng.submit([1], 40)                    # queued behind the first
@@ -159,11 +161,15 @@ def test_engine_validation_and_close(lm, eng3):
         r2.result(timeout=5)
     with pytest.raises(RuntimeError, match="closed"):
         eng.submit([1], 4)
+    # failed requests still retire for attribution: stamped + counted
+    # into the SLO error budget (ISSUE 6)
+    assert r2.t_retire is not None
+    assert monrt.SERVING_FAILURES.value() - f0 >= 1
 
 
 # -- telemetry: metrics, flight recorder, trace ----------------------------
 
-def test_serving_metrics_recorder_and_trace(rng, eng3, tmp_path):
+def test_serving_metrics_recorder_and_trace(rng, eng4, tmp_path):
     from paddle_tpu import monitor
     from paddle_tpu.trace import runtime as trt
     mlog = str(tmp_path / "mon.jsonl")
@@ -174,7 +180,7 @@ def test_serving_metrics_recorder_and_trace(rng, eng3, tmp_path):
     monitor.enable(log_path=mlog)
     trt.enable(log_path=tlog, sample_rate=1.0, proc="test-serving")
     try:
-        out = eng3.generate_many([[1], [1, 4, 7, 9], [1, 9]], [5, 6, 4])
+        out = eng4.generate_many([[1], [1, 4, 7, 9], [1, 9]], [5, 6, 4])
     finally:
         trt.disable()
         monitor.disable()
@@ -192,7 +198,7 @@ def test_serving_metrics_recorder_and_trace(rng, eng3, tmp_path):
     assert sum(r["emitted"] for r in steps) == total
     assert sum(r["admitted"] for r in steps) == 3
     assert sum(r["retired"] for r in steps) == 3
-    assert all(r["slots"] == 3 for r in steps)
+    assert all(r["slots"] == 4 for r in steps)
     # every engine iteration ran under an engine.step root span, and the
     # recorder rows carry its trace id — the fleet-timeline join key
     spans = [r for r in monitor.read_jsonl(tlog) if r["ev"] == "span"]
@@ -201,6 +207,96 @@ def test_serving_metrics_recorder_and_trace(rng, eng3, tmp_path):
     span_traces = {s["trace"] for s in estep}
     for r in steps:
         assert r.get("trace") in span_traces
+
+
+def test_request_lifecycle_slots4_armed(rng, lm, eng4, tmp_path):
+    """ISSUE-6 acceptance: every request of a slots=4 run carries
+    queue_wait/TTFT/TPOT on its Request handle (monotonic lifecycle
+    stamps), in serving_request recorder rows (with the request's
+    trace id + the new histograms), and as a serving.request span with
+    prefill-chunk children / first-token mark linked to engine.step
+    spans — while the token-identical-to-sequential contract holds
+    with the FULL instrumentation armed. Rides the shared slots=4
+    engine: no extra compiles on the tier-1 budget."""
+    import math
+    from paddle_tpu import monitor
+    from paddle_tpu.trace import merge as tmerge
+    from paddle_tpu.trace import runtime as trt
+    reqs = _requests(rng, 8, max_prompt=10, min_new=4, max_new=12)
+    assert max(len(p) for p, _ in reqs) > 4   # multi-chunk prefill real
+    seq = serving.sequential_generate(lm, reqs)
+    mlog, tlog = str(tmp_path / "mon.jsonl"), str(tmp_path / "sp.jsonl")
+    ttft0 = monrt.SERVING_TTFT.count(engine="engine")
+    monitor.enable(log_path=mlog)
+    trt.enable(log_path=tlog, sample_rate=1.0, proc="slo-test")
+    try:
+        handles = [eng4.submit(p, m) for p, m in reqs]
+        out = [h.result(timeout=120) for h in handles]
+    finally:
+        trt.disable()
+        monitor.disable()
+    _assert_identical(seq, out)
+
+    # 1) the Request handle: monotonic stamps + derived attribution
+    for (prompt, _), h in zip(reqs, handles):
+        assert h.t_enqueue <= h.t_admit <= h.t_first_token <= h.t_retire
+        assert h.queue_wait >= 0 and h.ttft > 0
+        assert h.tpot is not None and h.tpot >= 0
+        assert h.prefill_chunks == math.ceil((len(prompt) - 1) / 4)
+        lat = h.latency()
+        assert lat["tokens"] == len(h.tokens) > 0
+    assert monrt.SERVING_TTFT.count(engine="engine") - ttft0 \
+        == len(reqs)
+
+    # 2) recorder rows: one serving_request per request, trace-stamped
+    rows = monitor.read_jsonl(mlog)
+    rreq = [r for r in rows if r["ev"] == "serving_request"]
+    assert len(rreq) == len(reqs)
+    for r in rreq:
+        assert r["ttft"] > 0 and r["queue_wait"] >= 0
+        assert r["tpot"] is not None and r["tokens"] > 0
+        assert r.get("trace") and "error" not in r
+    # serving_step rows now carry the step wall time
+    rstep = [r for r in rows if r["ev"] == "serving_step"]
+    assert rstep and all(r["dt"] > 0 for r in rstep)
+
+    # 3) spans: request roots + prefill-chunk/first-token children
+    #    linked to engine.step spans; rows' trace ids join the lanes
+    spans = [r for r in monitor.read_jsonl(tlog) if r["ev"] == "span"]
+    rspans = [s for s in spans if s["name"] == "serving.request"]
+    assert len(rspans) == len(reqs)
+    assert {s["trace"] for s in rspans} == {r["trace"] for r in rreq}
+    for s in rspans:
+        at = s.get("attrs") or {}
+        assert at["ttft"] > 0 and "tpot" in at and "queue_wait" in at
+    rids = {s["span"] for s in rspans}
+    pf = [s for s in spans if s["name"] == "request.prefill_chunk"]
+    ft = [s for s in spans if s["name"] == "request.first_token"]
+    assert len(ft) == len(reqs)
+    assert len(pf) == sum(math.ceil((len(p) - 1) / 4) for p, _ in reqs)
+    assert all(s["parent"] in rids for s in pf + ft)
+    estep = {s["span"] for s in spans if s["name"] == "engine.step"}
+    assert all((s.get("attrs") or {}).get("step_span") in estep
+               for s in ft)
+
+    # 4) trace merge shows the request lanes next to the engine steps
+    merged, info = tmerge.merge_files([tlog])
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert {"serving.request", "request.prefill_chunk",
+            "engine.step"} <= names
+    assert info["spans"] == len(spans)
+
+    # 5) the recorded log satisfies a sane SLO spec end to end
+    from paddle_tpu import slo
+    v = slo.evaluate(
+        {"objectives": [
+            {"metric": "ttft", "percentile": 0.95, "max_seconds": 60},
+            {"metric": "tpot", "percentile": 0.99, "max_seconds": 60},
+            {"metric": "queue_wait", "percentile": 0.95,
+             "max_seconds": 60},
+            {"metric": "error_rate", "max_ratio": 0.0}]},
+        slo.samples_from_monitor_log(mlog))
+    assert v["pass"] is True and v["requests"] == len(reqs)
 
 
 # -- zero-copy feed path (core/executor FeedPlanCache) ---------------------
