@@ -54,6 +54,11 @@ def main():
             p.add_argument("--max_new", type=int, default=128),
             p.add_argument("--prefill_chunk", type=int, default=8),
             p.add_argument("--seed", type=int, default=7),
+            p.add_argument("--megastep", type=int, default=0,
+                           help="also measure a fused-K megastep "
+                                "engine pass (ISSUE 7): K decode "
+                                "iterations per dispatch, stamped as "
+                                "megastep_* fields (0 = skip)"),
             p.add_argument("--fast", action="store_true",
                            help="tier-1 CPU smoke: smaller request set")))
     import jax
@@ -116,7 +121,6 @@ def _run_bench(args):
     eng_out = [h.result() for h in handles]
     eng_dt = time.perf_counter() - t0
     occupancy = eng.occupancy()
-    eng.close()
 
     identical = all(st == et for (st, _), (et, _) in zip(seq_out, eng_out))
     seq_tps = total / seq_dt
@@ -140,6 +144,64 @@ def _run_bench(args):
         vals = sorted(v for v in vals if v is not None)
         v = percentile_sorted(vals, q)
         return None if v is None else round(1000.0 * v, 3)
+
+    if args.megastep > 1:
+        # fused-K pass (ISSUE 7): same request set through an engine
+        # that scans K decode iterations per dispatch when idle of
+        # admissions/prefills — token identity verified against the
+        # same sequential baseline, throughput stamped alongside.
+        # warmup() compiles BOTH dispatch paths up front: a K>1 engine
+        # otherwise meets the single-step path for the first time on a
+        # mid-flight admission and eats an XLA compile mid-measurement
+        eng2 = serving.Engine(infer, slots=args.slots,
+                              prefill_chunk=args.prefill_chunk,
+                              megastep=args.megastep,
+                              name="engine-mega").warmup()
+        eng2.generate_many([p for p, _ in warm], [m for _, m in warm])
+        t0 = time.perf_counter()
+        h2 = [eng2.submit(p, m) for p, m in reqs]
+        mega_out = [h.result() for h in h2]
+        mega_dt = time.perf_counter() - t0
+        mega_tps = total / mega_dt
+        out["megastep_k"] = args.megastep
+        out["megastep_tokens_per_sec"] = round(mega_tps, 1)
+        out["megastep_vs_engine"] = round(mega_tps / eng_tps, 2)
+        out["megastep_identical"] = bool(all(
+            st == et for (st, _), (et, _) in zip(seq_out, mega_out)))
+        out["megastep_dispatches"] = eng2.stats["megastep_dispatches"]
+        print("serving megastep K=%d: %.0f tok/s (%.2fx engine, "
+              "identical=%s, %d fused dispatches)"
+              % (args.megastep, mega_tps, mega_tps / eng_tps,
+                 out["megastep_identical"],
+                 out["megastep_dispatches"]), file=sys.stderr)
+        # bs1 dispatch-floor probe — the shape PERF.md round 5 pinned
+        # at 0.34 ms/token: ONE long request, so after prefill every
+        # iteration is pure decode. The K=1 engine pays one host
+        # dispatch per token; the fused engine pays one per K tokens.
+        # Interleaved A/B medians over 5 rounds.
+        import statistics
+        bs1_new = min(args.max_new, infer.max_len - 4)
+        bs1 = ([1, 4, 5], bs1_new)
+
+        def bs1_round(engine):
+            t0 = time.perf_counter()
+            toks, _ = engine.submit(*bs1).result()
+            return len(toks) / (time.perf_counter() - t0)
+
+        bs1_round(eng), bs1_round(eng2)        # warm prefill shapes
+        a, b = [], []
+        for _ in range(5):
+            a.append(bs1_round(eng))
+            b.append(bs1_round(eng2))
+        k1, k8 = statistics.median(a), statistics.median(b)
+        out["megastep_bs1_k1_tok_s"] = round(k1, 1)
+        out["megastep_bs1_tok_s"] = round(k8, 1)
+        out["megastep_bs1_speedup"] = round(k8 / k1, 2)
+        print("serving megastep bs1 floor: K=1 %.0f vs K=%d %.0f "
+              "tok/s (%.2fx)" % (k1, args.megastep, k8, k8 / k1),
+              file=sys.stderr)
+        eng2.close()
+    eng.close()
 
     ttft = [h.ttft for h in handles]
     tpot = [h.tpot for h in handles]

@@ -22,6 +22,8 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.monitor.watch",
     "paddle_tpu.serving",
     "paddle_tpu.serving.engine",
+    "paddle_tpu.reader",
+    "paddle_tpu.reader.device_loader",
     "paddle_tpu.slo",
     "paddle_tpu.trace",
     "paddle_tpu.trace.runtime",

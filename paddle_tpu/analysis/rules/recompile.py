@@ -7,6 +7,14 @@ object identity), and scalar floods (hundreds of 0-d args instead of
 one stacked array). All three are visible in the traced signature
 without running anything — the static analog of watching
 jax.monitoring recompile counters in production.
+
+Megastep awareness (ISSUE 7): a ``lax.scan`` body — the shape of
+gradient accumulation, Executor.run_steps megasteps and the serving
+engine's fused-K decode — is ONE compile unit whose trip count K is a
+static trace constant. The rule surfaces each scanned unit with its K
+so readers know a varying K (a K-sweep driven per run, a serving
+engine rebuilt at a new ``serving_megastep``) recompiles the WHOLE
+fused body, not just a wrapper.
 """
 
 from ..diagnostics import Diagnostic, WARNING, INFO
@@ -23,6 +31,29 @@ class RecompileHazardRule(Rule):
     def __init__(self, const_min_bytes=1 << 20, scalar_flood=32):
         self.const_min_bytes = const_min_bytes
         self.scalar_flood = scalar_flood
+
+    def _check_scanned_units(self, a):
+        """Each lax.scan body is one compile unit keyed on its trip
+        count K: megastep execution (Executor.run_steps, the serving
+        engine's fused-K decode) and gradient accumulation both compile
+        the WHOLE step body per distinct K, so a K that varies run to
+        run is a recompile hazard worth flagging — the fused body is
+        the most expensive trace in the program, not a thin wrapper."""
+        for view, eqn in a.iter_eqns():
+            if eqn.primitive.name != "scan":
+                continue
+            k = int(eqn.params.get("length", 1) or 1)
+            if k < 2:
+                continue
+            yield Diagnostic(
+                self.name, INFO,
+                "scanned compile unit (K=%d trips) at %s — the body "
+                "(megastep / grad-accum / fused decode) is ONE compile "
+                "unit keyed on K: a K that varies across runs re-traces"
+                " and recompiles the whole fused body"
+                % (k, view.eqn_path(eqn)),
+                hint="pin K per workload (flags serving_megastep / "
+                     "run_steps k) instead of deriving it per batch")
 
     def check(self, a):
         jaxpr = a.closed_jaxpr.jaxpr
@@ -63,6 +94,8 @@ class RecompileHazardRule(Rule):
                     % (list(shape), nb / (1 << 20)),
                     hint="pass it as a function argument (donated "
                          "state) instead of closing over it")
+        for d in self._check_scanned_units(a):
+            yield d
         # informational: how much of the signature is traced state
         yield Diagnostic(
             self.name, INFO,

@@ -284,6 +284,72 @@ class FeedPlanCache:
             self._plans.clear()
 
 
+def _stack_step_feeds(feeds, plan_cache=None):
+    """Normalize K per-step feed dicts and stack them into the
+    ``[k, ...]`` megastep staging layout ``run_steps`` scans in-graph.
+
+    Every per-step feed must land on ONE compiled-step signature (same
+    names/shapes/dtypes and the same trace-time static_info): the scan
+    body is compiled once, so a step whose bucketed LoD total or MAXLEN
+    bucket differs cannot share the megastep. That is checked here with
+    a loud error instead of a shape mismatch inside the scan. LoD feeds
+    are supported — each step's flat buffer/length vectors normalize
+    through the (shared) feed-plan cache exactly as ``run()`` would,
+    then stack; only the PRE-STACKED staging path (DeviceLoader
+    ``megabatches``) excludes them.
+
+    Returns (feeds_k, static_info, per_step_signature)."""
+    normed = [_normalize_feeds(dict(f or {}), plan_cache=plan_cache)
+              for f in feeds]
+    arrays0, info0 = normed[0]
+    sig0 = _feed_signature(arrays0)
+    for i, (arrays_i, info_i) in enumerate(normed[1:], 1):
+        if _feed_signature(arrays_i) != sig0 or info_i != info0:
+            raise ValueError(
+                "run_steps feeds must share ONE compiled-step "
+                "signature (the megastep scan body is compiled once): "
+                "feed %d normalizes to %s / static %s, feed 0 to %s / "
+                "static %s. Pad or re-bucket the odd batch, or run() "
+                "it separately." % (i, _feed_signature(arrays_i),
+                                    sorted(info_i.items()), sig0,
+                                    sorted(info0.items())))
+    feeds_k = {}
+    for name in arrays0:
+        vals = [a[name] for a, _ in normed]
+        if all(isinstance(v, jax.Array) for v in vals):
+            feeds_k[name] = jnp.stack(vals)
+        else:
+            feeds_k[name] = np.stack([np.asarray(v) for v in vals])
+    return feeds_k, dict(info0), sig0
+
+
+def _stage_prestacked_feeds(feeds, k):
+    """Validate a pre-stacked ``[k, ...]`` staging dict (the
+    DeviceLoader ``megabatches`` layout). Dense arrays only: a
+    LoDTensor's normalization needs trace-time static_info only the
+    per-step host path can derive, so it gets a clear error here
+    instead of a shape mismatch inside the scan."""
+    feeds_k = {}
+    for name, v in feeds.items():
+        if isinstance(v, LoDTensor):
+            raise ValueError(
+                "LoD feed %r cannot ride the pre-stacked [k, ...] "
+                "megastep staging path: its flat/bucketed form and "
+                "@LOD/@MAXLEN static_info must be derived per step by "
+                "the executor's own normalization. Pass run_steps a "
+                "LIST of per-step feed dicts instead (the host path "
+                "normalizes and stacks LoD feeds correctly)." % name)
+        arr = v if isinstance(v, jax.Array) else np.asarray(v)
+        if getattr(arr, "ndim", 0) < 1 or arr.shape[0] != k:
+            raise ValueError(
+                "pre-stacked megastep feed %r must have leading dim "
+                "k=%d, got shape %s" % (name, k, np.shape(arr)))
+        feeds_k[name] = arr
+    sig = tuple(sorted((n, tuple(np.shape(v)[1:]), str(v.dtype))
+                       for n, v in feeds_k.items()))
+    return feeds_k, {}, sig
+
+
 def as_numpy(value):
     """Convert a fetched value (jax.Array / LoDTensor / list) to numpy."""
     from .selected_rows import SelectedRows
@@ -369,6 +435,236 @@ class Executor:
             return self._run_impl(program, feed, fetch_list,
                                   feed_var_name, fetch_var_name, scope,
                                   return_numpy, use_program_cache)
+
+    # -- megastep execution (ISSUE 7) ----------------------------------
+    def run_steps(self, program=None, feeds=None, fetch_list=None,
+                  scope=None, return_numpy=True, k=None,
+                  use_program_cache=True):
+        """K logical steps in ONE device dispatch (the megastep path).
+
+        The per-step body ``run()`` compiles — forward, backward AND
+        optimizer/persistable-state update — is scanned (``lax.scan``)
+        over K batches, so one host dispatch advances K real training
+        steps; per-step fetches (losses, NaN guards, fetch LoDs) stream
+        out of the scan. The contract is numeric identity with K
+        sequential ``run()`` calls on the same feeds (same per-step RNG
+        stream included) — pinned in tests/test_megastep.py.
+
+        ``feeds``: either a LIST of K per-step feed dicts (LoD feeds
+        supported; each normalizes through the feed-plan cache and all
+        K must share one signature), or ONE pre-stacked ``[k, ...]``
+        dict (the DeviceLoader ``megabatches`` staging layout; dense
+        only) together with ``k``.
+
+        Returns a list of K per-step fetch lists. With
+        ``return_numpy=False`` the fetches stay device-resident and the
+        dispatch is ASYNC: up to ``PADDLE_TPU_MEGASTEP_INFLIGHT``
+        (default 2 = double buffering) megastep dispatches may be in
+        flight before the next call blocks on the oldest, so the host
+        feed of megastep N+1 overlaps device compute of megastep N.
+
+        Semantic differences vs K sequential runs, by design: NaN
+        guards are checked after the whole dispatch (the error names
+        the first failing logical step, but state has advanced all K
+        steps), and programs with host (IO) ops or newly-materialized
+        persistables (startup programs) are rejected — run() those."""
+        feeds, k = self._check_run_steps_args(feeds, k)
+        trc = _trc._TRACER
+        if trc is None:
+            return self._run_steps_impl(program, feeds, fetch_list,
+                                        scope, return_numpy, k,
+                                        use_program_cache)
+        with trc.span("exe.step", k=k):
+            return self._run_steps_impl(program, feeds, fetch_list,
+                                        scope, return_numpy, k,
+                                        use_program_cache)
+
+    @staticmethod
+    def _check_run_steps_args(feeds, k):
+        if isinstance(feeds, dict):
+            if k is None:
+                raise ValueError(
+                    "run_steps(feeds=<pre-stacked dict>) needs k= (the "
+                    "leading staging dim); pass a list of per-step "
+                    "feed dicts to infer it")
+            k = int(k)
+        else:
+            feeds = list(feeds or [])
+            if k is not None and int(k) != len(feeds):
+                raise ValueError(
+                    "run_steps got k=%r but %d per-step feeds"
+                    % (k, len(feeds)))
+            k = len(feeds)
+        if k < 1:
+            raise ValueError("run_steps needs k >= 1, got %d" % k)
+        return feeds, k
+
+    def _run_steps_impl(self, program, feeds, fetch_list, scope,
+                        return_numpy, k, use_program_cache):
+        import time as _time
+        program = program or default_main_program()
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else str(f)
+            for f in fetch_list)
+        if any(registry.is_host_op(o.type)
+               for o in program.global_block().ops):
+            raise NotImplementedError(
+                "run_steps cannot fuse programs with host (IO) ops — "
+                "send/recv/prefetch must hit the wire once per step; "
+                "use run() per step")
+        if isinstance(feeds, dict):
+            feeds_k, static_info, sig = _stage_prestacked_feeds(feeds, k)
+        else:
+            feeds_k, static_info, sig = _stack_step_feeds(
+                feeds, plan_cache=getattr(self, "_feed_plans", None))
+
+        persistable = [v.name
+                       for v in program.global_block().vars.values()
+                       if v.persistable]
+        state = {n: scope.find_var(n) for n in persistable
+                 if scope.find_var(n) is not None}
+        state_keys = tuple(sorted(state))
+
+        from ..amp import amp_enabled
+        from ..flags import get_flag
+        check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
+        key = ("megastep", k, program, program._version, sig,
+               fetch_names, state_keys, amp_enabled(), check_nan,
+               get_flag("fuse_conv_bn"),
+               tuple(sorted(static_info.items())))
+        from .. import monitor as _mon
+        mon_on = _mon.enabled()
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            mega = self._build_megastep(program, tuple(sorted(feeds_k)),
+                                        fetch_names, state_keys,
+                                        static_info, check_nan, k)
+            entry = jax.jit(mega, donate_argnums=(0,))
+            if use_program_cache:
+                self._cache[key] = entry
+            if mon_on and use_program_cache:
+                rng0 = jax.vmap(jax.random.key)(
+                    jnp.zeros((k,), jnp.uint32))
+                _mon.on_compile(
+                    program, key, key[4],
+                    cost_fn=lambda: _step_costs_safe(
+                        mega, dict(state), dict(feeds_k), rng0),
+                    tokens=_mon.tokens_in_feeds(feeds_k))
+        elif mon_on:
+            _mon.on_cache_hit()
+
+        # one RNG stream position per LOGICAL step — the same
+        # derivation run() uses, so megastep output is bitwise equal to
+        # K sequential run() calls (dropout masks included)
+        base = program.random_seed * 1000003 + self._rng_counter
+        self._rng_counter += k
+        keys = jax.vmap(jax.random.key)(jnp.asarray(
+            [np.uint32(base + i) for i in range(k)]))
+
+        window = max(1, int(get_flag("megastep_inflight")))
+        inflight = self.__dict__.setdefault("_inflight", [])
+        while len(inflight) >= window:
+            # double-buffer window full: the OLDEST dispatch must
+            # retire before another joins the pipeline
+            jax.block_until_ready(inflight.pop(0))
+
+        t0 = _time.perf_counter() if mon_on else 0.0
+        if mon_on:
+            timer = _mon.step_timer(self)
+            do_sync = timer.begin(t0)
+        with jax.default_device(self.place.jax_device()):
+            fetches_k, new_state, guards_k, lods_k = entry(
+                state, feeds_k, keys)
+        if mon_on:
+            fb = _mon.feed_nbytes(feeds_k)
+            tk = _mon.tokens_in_feeds(feeds_k)
+            if do_sync:
+                jax.block_until_ready(fetches_k)
+                _mon.on_megastep(
+                    key, timer.end_synced(_time.perf_counter(), t0), k,
+                    feed_bytes=fb, tokens=tk)
+            else:
+                _mon.on_megastep(key, _time.perf_counter() - t0, k,
+                                 feed_bytes=fb, tokens=tk, synced=False)
+
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if check_nan:
+            self._check_guards_steps(guards_k, k)
+        out = self._split_step_fetches(fetch_names, fetches_k, lods_k,
+                                       k, return_numpy)
+        if check_nan:
+            for i, fi in enumerate(out):
+                self._check_nan_inf(fetch_names, fi)
+        if not return_numpy:
+            # async dispatch: hand back device handles and track the
+            # un-fetched dispatch in the in-flight window
+            inflight.append(fetches_k)
+        return out
+
+    def _build_megastep(self, program, feed_names, fetch_names,
+                        state_keys, static_info, check_nan, k):
+        """Wrap the compiled-step body in a lax.scan over K stacked
+        batches: ONE compile unit keyed on K, one dispatch per K
+        logical steps."""
+        step = self._build(program, feed_names, fetch_names, state_keys,
+                           static_info=static_info, check_nan=check_nan)
+
+        def mega(state, feeds_k, keys):
+            def body(carry, xs):
+                feeds_i, key_i = xs
+                fetches, new_state, guards, fetch_lods = step(
+                    carry, feeds_i, key_i)
+                extra = sorted(set(new_state) - set(carry))
+                if extra:       # trace-time check, not a runtime branch
+                    raise ValueError(
+                        "run_steps: the program materializes new "
+                        "persistable vars %s inside the step — the "
+                        "scan carry pytree must be stable. run() the "
+                        "startup/first step once, then megastep."
+                        % extra)
+                carry = {n: new_state[n] for n in carry}
+                return carry, (fetches, guards, fetch_lods)
+
+            final, (fetches_k, guards_k, lods_k) = jax.lax.scan(
+                body, state, (feeds_k, keys))
+            return fetches_k, final, guards_k, lods_k
+
+        return mega
+
+    @staticmethod
+    def _split_step_fetches(fetch_names, fetches_k, lods_k, k,
+                            return_numpy):
+        """[k, ...]-stacked scan outputs → K per-step fetch lists, with
+        per-step LoD bucket-pad trimming (the run() contract)."""
+        out = []
+        for i in range(k):
+            fi = [f[i] for f in fetches_k]
+            lodi = {n: v[i] for n, v in lods_k.items()}
+            fi = Executor._trim_fetches(fetch_names, fi, lodi)
+            out.append([as_numpy(v) for v in fi] if return_numpy
+                       else fi)
+        return out
+
+    @staticmethod
+    def _check_guards_steps(guards_k, k):
+        """Per-logical-step NaN-guard check over the [k]-stacked guard
+        outputs; names the FIRST failing step. Unlike K sequential
+        runs, state has already advanced all K steps by the time this
+        raises (documented run_steps semantics)."""
+        if not guards_k:
+            return
+        guards_k = jax.device_get(guards_k)
+        for i in range(k):
+            try:
+                Executor._check_guards(
+                    {g: np.asarray(v)[i] for g, v in guards_k.items()})
+            except FloatingPointError as e:
+                raise FloatingPointError(
+                    "%s (at megastep logical step %d of %d; state has "
+                    "advanced the full megastep)" % (e, i, k)) from None
 
     def _run_impl(self, program, feed, fetch_list, feed_var_name,
                   fetch_var_name, scope, return_numpy,

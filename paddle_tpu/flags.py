@@ -30,7 +30,10 @@ Registered flags:
   feed_plan_cache bool  cache _normalize_feeds plans + committed device
                         feed buffers across same-signature run() calls
   serving*        —     paddle_tpu.serving continuous-batching engine
-                        knobs (prefill chunk length, admission window)
+                        knobs (prefill chunk length, admission window,
+                        fused decode megastep K)
+  megastep_inflight int Executor.run_steps async dispatch window depth
+                        (2 = double buffering)
   slo_spec        str   default SLO spec JSON for python -m
                         paddle_tpu.slo and the live verdict line of
                         python -m paddle_tpu.monitor watch
@@ -177,6 +180,21 @@ _register("serving_admission_wait", float, 0.0,
           "IDLE engine holds admissions up to this long for the queue "
           "to fill to the slot count before starting a sparse batch. "
           "0 = greedy fill (admit at the next step boundary)")
+_register("serving_megastep", int, 1,
+          "serving.Engine decode iterations fused into ONE device "
+          "dispatch (lax.scan over the slot step) when no admissions "
+          "or prefills are pending — attacks the measured bs1 "
+          "per-step dispatch floor (PERF.md round 5). Admissions and "
+          "retirement bookkeeping land at megastep boundaries; output "
+          "stays token-identical to the K=1 engine. 1 = one dispatch "
+          "per decode step (the PR-5 behavior)")
+_register("megastep_inflight", int, 2,
+          "Executor.run_steps async dispatch window: how many "
+          "un-fetched megastep dispatches may be in flight before the "
+          "next run_steps(return_numpy=False) call blocks on the "
+          "oldest. 2 = double buffering (host feed of megastep N+1 "
+          "overlaps device compute of megastep N); 1 restores "
+          "serialized dispatch")
 _register("slo_spec", str, "",
           "default SLO spec JSON path: python -m paddle_tpu.slo uses "
           "it when no spec argument is given, and python -m "

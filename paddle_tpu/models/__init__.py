@@ -26,6 +26,8 @@ ZOO = {
                         "analysis_entry_moe"),
     "transformer_infer": ("paddle_tpu.models.transformer_infer",
                           "analysis_entry_infer"),
+    "serving_megastep": ("paddle_tpu.models.transformer_infer",
+                         "analysis_entry_serving_megastep"),
 }
 
 

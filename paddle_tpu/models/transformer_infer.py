@@ -416,13 +416,13 @@ class TransformerLMInfer(TransformerInfer):
                                       batch)
 
 
-def analysis_entry_infer():
-    """Static-analyzer entry: bf16 KV-cached greedy decode — the
-    serving graph whose precision invariants (bf16 weights/caches, f32
-    softmax + LN stats + log-probs) the dtype-promotion rule verifies
-    statically. Params are passed as an argument pytree (not closed
-    over) so the recompile-hazard rule sees the real serving
-    signature."""
+_LM_PNAMES = ("word_emb", "pos_emb", "layers", "w_out")
+
+
+def _small_lm_for_analysis(dtype=None):
+    """The tiny flagship-LM build the analyzer entries trace (2L/d32,
+    max_len 16 — device-free beyond startup init on whatever
+    JAX_PLATFORMS provides)."""
     import paddle_tpu as fluid
 
     main, startup = fluid.Program(), fluid.Program()
@@ -433,15 +433,54 @@ def analysis_entry_infer():
                        d_model=32, d_inner=64)
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
-        infer = TransformerLMInfer(main, scope, n_layer=2, n_head=2,
-                                   d_model=32, max_len=16,
-                                   dtype=jnp.bfloat16)
-    pnames = ("word_emb", "pos_emb", "layers", "w_out")
-    params = {n: getattr(infer, n) for n in pnames}
+        return TransformerLMInfer(main, scope, n_layer=2, n_head=2,
+                                  d_model=32, max_len=16, dtype=dtype)
+
+
+def analysis_entry_infer():
+    """Static-analyzer entry: bf16 KV-cached greedy decode — the
+    serving graph whose precision invariants (bf16 weights/caches, f32
+    softmax + LN stats + log-probs) the dtype-promotion rule verifies
+    statically. Params are passed as an argument pytree (not closed
+    over) so the recompile-hazard rule sees the real serving
+    signature."""
+    infer = _small_lm_for_analysis(dtype=jnp.bfloat16)
+    params = {n: getattr(infer, n) for n in _LM_PNAMES}
 
     def fn(params):
-        for n in pnames:
+        for n in _LM_PNAMES:
             setattr(infer, n, params[n])
         return infer.generate(2, max_out_len=8)
 
     return fn, (params,)
+
+
+def analysis_entry_serving_megastep():
+    """Static-analyzer entry for the ISSUE-7 fused-K serving decode:
+    the continuous-batching engine's megastep body — K=4 slot decode
+    iterations (``_step_logits_slots`` + greedy sampling state) scanned
+    into ONE device program over the ``[slots, ...]`` KV-cache state.
+    Traces the REAL ``serving.Engine._megastep_impl`` so the
+    recompile-hazard rule's scanned-unit heuristic sees the production
+    fused body (K is a static trace constant: varying it recompiles
+    the whole unit), and the dtype rule audits the megastep at the
+    same bf16-weights / f32-score precision contract as the plain
+    decode entry."""
+    from ..serving.engine import Engine
+
+    infer = _small_lm_for_analysis(dtype=jnp.bfloat16)
+    eng = Engine(infer, slots=2, prefill_chunk=4, megastep=4,
+                 name="analysis")
+    # tracing only: the scheduler thread is stopped before the entry is
+    # handed to the analyzer (megastep_impl is a pure function of state)
+    eng.close()
+    params = {n: getattr(infer, n) for n in _LM_PNAMES}
+    state = dict(eng._state)
+
+    def fn(params, state):
+        for n in _LM_PNAMES:
+            setattr(infer, n, params[n])
+        state, emits, fins = eng._megastep_impl(state)
+        return emits, fins, state["score"]
+
+    return fn, (params, state)

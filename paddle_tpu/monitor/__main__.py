@@ -28,6 +28,11 @@ def summarize_log(path):
     events, skipped = read_jsonl_tolerant(path)
     steps = [e for e in events if e["ev"] == "step"]
     compiles = [e for e in events if e["ev"] == "compile"]
+    # a megastep row is ONE dispatch advancing k logical steps with
+    # dt = per-logical-step wall time — counts and totals weight by k
+    # so figures stay comparable across K (the ISSUE-7 contract)
+    def _k(e):
+        return int(e.get("k") or 1)
     # latency percentiles use SYNCED samples only: unsynced steps
     # (monitor_sync_every amortization) logged dispatch time, not wall
     dts = sorted(e["dt"] for e in steps
@@ -46,10 +51,12 @@ def summarize_log(path):
         "events": len(events),
         "platform": dev.get("platform"),
         "device_kind": dev.get("device_kind"),
-        "steps": len(steps),
+        "steps": sum(_k(e) for e in steps),
         "p50_s": _percentile(dts, 0.50),
         "p95_s": _percentile(dts, 0.95),
-        "total_step_s": sum(dts),
+        "total_step_s": sum(e["dt"] * _k(e) for e in steps
+                            if e.get("dt") is not None
+                            and e.get("synced", True)),
         "compiles": len(compiles),
         "compile_reasons": reasons,
         "recompiles": sum(1 for c in compiles if c.get("recompile")),
@@ -85,7 +92,8 @@ def _summarize_serving(events):
     qw = sorted(s["queue_wait"])
     occ = [e["active"] / e["slots"] for e in sstep if e.get("slots")]
     return {
-        "steps": len(sstep),
+        # fused serving_step rows (megastep) advance k decode steps
+        "steps": sum(int(e.get("k") or 1) for e in sstep),
         "step_p50_s": _percentile(sdts, 0.50),
         "step_p95_s": _percentile(sdts, 0.95),
         "mean_occupancy": (sum(occ) / len(occ)) if occ else None,

@@ -36,8 +36,8 @@ __all__ = [
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
     "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
     "on_checkpoint", "on_serving_step", "on_serving_request",
-    "on_feed_plan", "summary", "session", "prometheus_text",
-    "dump_metrics",
+    "on_feed_plan", "on_megastep", "summary", "session",
+    "prometheus_text", "dump_metrics",
 ]
 
 _REG = _metrics.registry()
@@ -140,6 +140,20 @@ SERVING_STEP_SECONDS = _REG.histogram(
     "is excluded) — the serving analogue of ptpu_step_seconds, so an "
     "SLO step_latency objective gates the SAME quantity from a "
     "metrics snapshot as from the recorder rows", ("engine",))
+# megastep execution (ISSUE 7): K logical steps fused into ONE device
+# dispatch (Executor.run_steps / ParallelExecutor.run_steps /
+# serving.Engine megastep). Latency/MFU/tokens-s figures stay PER
+# LOGICAL STEP (the megastep wall time divided by K) so dashboards and
+# SLO step_latency gates read the same quantity at any K; these two
+# counters make the fusion itself scrapeable (dispatch tax saved =
+# steps_total - dispatches_total host round-trips)
+MEGASTEP_DISPATCHES = _REG.counter(
+    "ptpu_megastep_dispatches_total",
+    "fused K-step device dispatches (K > 1)", ("executor",))
+MEGASTEP_STEPS = _REG.counter(
+    "ptpu_megastep_steps_total",
+    "logical steps advanced inside fused K-step dispatches",
+    ("executor",))
 # feed-plan cache (core/executor): a normalization is the full per-call
 # feed re-marshal PERF.md round 5 measured; a plan hit skipped it
 FEED_NORMALIZATIONS = _REG.counter(
@@ -546,6 +560,64 @@ def on_step(key, dt, feed_bytes=0, tokens=0, executor="exe",
     _sample_device_memory()
 
 
+def on_megastep(key, dt, k, feed_bytes=0, tokens=0, executor="exe",
+                synced=True):
+    """One fused K-step dispatch (Executor.run_steps /
+    ParallelExecutor.run_steps) completed in ``dt`` seconds of wall
+    time. Latency, MFU and tokens/s all derive PER LOGICAL STEP — the
+    megastep wall time divided by K — so dashboards, the monitor CLI
+    and SLO step_latency gates read the same quantity at any K. The
+    compile-time cost entry for ``key`` priced the WHOLE megastep (K
+    scanned steps), so MFU uses the full dt. ``tokens`` is the total
+    across all K logical steps."""
+    if not _S.on:
+        return
+    rec, dog = _S.rec, _S.dog    # see on_compile: disable() race
+    _maybe_record_devices()
+    k = max(1, int(k))
+    per = dt / k
+    STEPS.inc(k, executor=executor)
+    MEGASTEP_DISPATCHES.inc(executor=executor)
+    MEGASTEP_STEPS.inc(k, executor=executor)
+    if synced:
+        for _ in range(k):
+            STEP_SECONDS.observe(per, executor=executor)
+    if feed_bytes:
+        FEED_BYTES.inc(feed_bytes)
+    mfu = None
+    with _S.lock:
+        cost = _S.costs.get(key) if key is not None else None
+        if cost is not None:
+            _S.costs.move_to_end(key)
+    if synced and cost is not None and dt > 0:
+        if _S.peak_flops is None:
+            _S.peak_flops = _auto_peak_flops() or 0.0
+        if _S.peak_flops:
+            mfu = cost["flops"] / dt \
+                / (_S.peak_flops * cost.get("devices", 1))
+            MFU.set(mfu)
+    tps = None
+    if synced and tokens and dt > 0:
+        tps = tokens / dt
+        TOKENS_PER_SEC.set(tps)
+    if dog is not None:
+        dog.touch()
+    with _S.lock:
+        _S.step_serial += k
+        serial = _S.step_serial
+    if rec is not None:
+        extra = {}
+        tr = _active_trace_id()
+        if tr is not None:
+            extra["trace"] = tr
+        # ONE row per dispatch; dt is the PER-LOGICAL-STEP figure the
+        # CLI/SLO surfaces gate, megastep_dt the raw dispatch wall time
+        rec.record("step", executor=executor, n=serial, dt=per, k=k,
+                   megastep_dt=dt, feed_bytes=feed_bytes, tokens=tokens,
+                   mfu=mfu, tokens_per_sec=tps, synced=synced, **extra)
+    _sample_device_memory()
+
+
 def on_nan_trip(where, detail=""):
     if not _S.on:
         return
@@ -619,11 +691,21 @@ def on_checkpoint(step, path, mode):
 # -- serving hooks (paddle_tpu.serving continuous-batching engine) ---------
 
 def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
-                    retired=0, engine="engine", dt=None):
+                    retired=0, engine="engine", dt=None, k=1,
+                    dispatched=None):
     """One engine iteration completed: gauges reflect the step, counters
     accumulate, and (recorder armed) a ``serving_step`` row lands with
     the step wall time and the active trace id so the fleet timeline
-    can join engine steps."""
+    can join engine steps. Fused megastep iterations: ``dt`` is the
+    whole dispatch, ``dispatched`` the scan trips the device ran
+    (defaults to ``k``), ``k`` the decode steps actually consumed — a
+    drain-tail megastep consumes fewer than it dispatched when every
+    live slot retires early. The histogram observes (and the row
+    reports) the PER-LOGICAL-STEP figure dt/dispatched, once per
+    consumed step, so SLO step_latency gates stay comparable across
+    K and a drain-tail dispatch cannot overstate per-step latency."""
+    k = max(1, int(k))
+    d = max(k, int(dispatched or k))
     SERVING_QUEUE_DEPTH.set(queue_depth)
     SERVING_SLOT_OCCUPANCY.set(active / slots if slots else 0.0)
     if emitted:
@@ -632,14 +714,21 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
         SERVING_ADMISSIONS.inc(admitted)
     if retired:
         SERVING_RETIREMENTS.inc(retired)
+    per = None if dt is None else dt / d
     if dt is not None:
-        SERVING_STEP_SECONDS.observe(dt, engine=engine)
+        for _ in range(k):
+            SERVING_STEP_SECONDS.observe(per, engine=engine)
+    if d > 1:
+        MEGASTEP_DISPATCHES.inc(executor=engine)
+        MEGASTEP_STEPS.inc(k, executor=engine)
     rec = _S.rec
     if rec is not None:
+        extra = {} if d == 1 else {"k": k, "megastep_dt": dt,
+                                   "dispatched": d}
         rec.record("serving_step", engine=engine, active=active,
                    slots=slots, queue_depth=queue_depth,
                    emitted=emitted, admitted=admitted, retired=retired,
-                   dt=dt, **_trace_extra())
+                   dt=per, **extra, **_trace_extra())
 
 
 def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,

@@ -90,7 +90,9 @@ class WatchState:
             self.last_ts = e["ts"]
         ev = e["ev"]
         if ev == "serving_step":
-            self.total_serving_steps += 1
+            # a fused megastep row advances k logical steps (dt stays
+            # per-logical-step) — weight so totals are K-comparable
+            self.total_serving_steps += int(e.get("k") or 1)
             self.serving_steps.append(e)
         elif ev == "serving_request":
             self.total_requests += 1
@@ -98,7 +100,7 @@ class WatchState:
                 self.total_errors += 1
             self.requests.append(e)
         elif ev == "step":
-            self.total_train_steps += 1
+            self.total_train_steps += int(e.get("k") or 1)
             self.train_steps.append(e)
         elif ev == "stall":
             self.stalls += 1

@@ -152,6 +152,240 @@ class ParallelExecutor:
             return self._run_impl(fetch_list, feed, feed_dict,
                                   return_numpy)
 
+    @staticmethod
+    def _local_value(v):
+        """Host view of one fetched value. A replicated output's
+        sharding spans remote devices; its local shard IS the value. A
+        dp-SHARDED fetch has no local full value: with FLAGS
+        gather_sharded_fetches on, all-gather it so every process
+        fetches the merged global array (the reference merged fetched
+        tensors across devices, parallel_executor.cc:190-197); default
+        stays the loud refusal rather than handing back 1/N of the
+        batch."""
+        from ..flags import get_flag
+        if jax.process_count() > 1 and isinstance(v, jax.Array) \
+                and not v.is_fully_addressable:
+            if not v.sharding.is_fully_replicated:
+                if get_flag("gather_sharded_fetches"):
+                    from jax.experimental import multihost_utils
+                    return np.asarray(
+                        multihost_utils.process_allgather(
+                            v, tiled=True))
+                raise NotImplementedError(
+                    "fetching a cross-process SHARDED value (spec %s) "
+                    "is not supported — fetch replicated values "
+                    "(losses/metrics), gather in-graph first, or set "
+                    "PADDLE_TPU_GATHER_SHARDED_FETCHES=1 to all-"
+                    "gather at fetch time" % (v.sharding.spec,))
+            return np.asarray(list(v.addressable_shards)[0].data)
+        return v
+
+    @staticmethod
+    def _to_global(v, sh):
+        """Place one host/device value per its target sharding.
+        Steady-state device outputs pass through (committed GSPMD
+        layouts stay; a multi-process array cannot be resharded
+        host-side anyway); an addressable but mis-placed array (e.g. a
+        single-device startup output vs a tp sharding hint) is laid
+        out per the hint. On a multi-process (multi-host) mesh, host
+        values become GLOBAL arrays via make_array_from_callback —
+        every process passes the same full array (the reference's
+        same-data-every-trainer contract, BCastParamsToGPUs parity)
+        and keeps only its addressable shards."""
+        multiproc = jax.process_count() > 1
+        if isinstance(v, jax.Array):
+            if not v.is_fully_addressable or v.sharding == sh:
+                return v
+            if multiproc:
+                v = np.asarray(v)
+            else:
+                return jax.device_put(v, sh)
+        if multiproc:
+            arr = np.asarray(v)
+            return jax.make_array_from_callback(
+                arr.shape, sh, lambda idx, _a=arr: _a[idx])
+        return jax.device_put(v, sh)
+
+    # -- megastep execution (ISSUE 7) ----------------------------------
+    def run_steps(self, fetch_list, feeds=None, return_numpy=True,
+                  k=None):
+        """K logical steps in ONE sharded device dispatch — the
+        ParallelExecutor twin of ``Executor.run_steps`` (same feeds
+        contract: a list of K per-step feed dicts, or one pre-stacked
+        ``[k, ...]`` dict plus ``k``). The scanned step body is the
+        same GSPMD-sharded program ``run()`` compiles; batch feeds
+        shard on the mesh's ``dp`` axis along dim 1 (dim 0 is the scan
+        dim). Returns K per-step fetch lists. Async double buffering
+        rides the same ``megastep_inflight`` window as the core
+        executor when ``return_numpy=False``."""
+        from ..core.executor import Executor as _Exe
+        feeds, k = _Exe._check_run_steps_args(feeds, k)
+        from ..trace import runtime as _trc
+        trc = _trc._TRACER
+        if trc is None:
+            return self._run_steps_impl(fetch_list, feeds, k,
+                                        return_numpy)
+        with trc.span("pexe.step", k=k):
+            return self._run_steps_impl(fetch_list, feeds, k,
+                                        return_numpy)
+
+    def _run_steps_impl(self, fetch_list, feeds, k, return_numpy):
+        import time as _time
+        from ..core.executor import (Executor as _Exe, _flag_on,
+                                     _stack_step_feeds,
+                                     _stage_prestacked_feeds,
+                                     _step_costs_safe)
+        if self._accum_steps > 1:
+            raise ValueError(
+                "run_steps does not compose with gradient_"
+                "accumulation_steps=%d: the megastep scan would nest "
+                "the accumulation scan and change the optimizer "
+                "cadence. Megastep K already amortizes dispatch; use "
+                "one or the other." % self._accum_steps)
+        program = self._program
+        scope = self._scope
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else str(f)
+            for f in (fetch_list or []))
+        if isinstance(feeds, dict):
+            feeds_k, static_info, sig = _stage_prestacked_feeds(feeds, k)
+        else:
+            feeds_k, static_info, sig = _stack_step_feeds(
+                feeds, plan_cache=self._feed_plans)
+
+        dp = 1
+        if "dp" in self.mesh.axis_names:
+            dp = self.mesh.shape["dp"]
+        # ragged LoD buffers stay replicated (SplitLoDTensor parity,
+        # same classification as _run_impl): the derived @LOD/@ACCUM
+        # vectors by suffix AND the flat token buffer itself, found by
+        # its original per-step feed value being a LoDTensor — its dim
+        # 1 is a data-dependent token total, not a batch dim
+        lod_keys = {n for n in feeds_k
+                    if n.endswith("@LOD") or n.endswith("@ACCUM_TOKENS")}
+        if not isinstance(feeds, dict):
+            lod_keys |= {n for f in feeds for n, v in (f or {}).items()
+                         if isinstance(v, LoDTensor)}
+        for n, v in feeds_k.items():
+            if n not in lod_keys and getattr(v, "ndim", 0) >= 2 \
+                    and v.shape[1] % dp != 0:
+                raise ValueError(
+                    "megastep feed %r per-step batch dim %d not "
+                    "divisible by dp=%d" % (n, v.shape[1], dp))
+
+        persistable = [v.name
+                       for v in program.global_block().vars.values()
+                       if v.persistable]
+        state = {n: scope.find_var(n) for n in persistable
+                 if scope.find_var(n) is not None}
+        state_keys = tuple(sorted(state))
+        hints = tuple(sorted(
+            (n, tuple(v)) for n, v in program._sharding_hints.items()))
+        from ..amp import amp_enabled, enable_amp
+        from ..flags import get_flag
+        check_nan = _flag_on("PADDLE_TPU_CHECK_NAN_INF")
+        use_amp = self._force_bf16 if self._force_bf16 is not None \
+            else amp_enabled()
+        key = ("megastep", k, program, program._version, sig,
+               fetch_names, state_keys, hints, check_nan, use_amp,
+               get_flag("fuse_conv_bn"),
+               tuple(sorted(static_info.items())))
+        from .. import monitor as _mon
+        mon_on = _mon.enabled()
+        entry = self._cache.get(key)
+        if entry is not None and mon_on:
+            _mon.on_cache_hit()
+        if entry is None:
+            mega = self._exe._build_megastep(
+                program, tuple(sorted(feeds_k)), fetch_names,
+                state_keys, static_info, check_nan, k)
+
+            def fn(state, feeds, keys, _fn=mega, _amp=use_amp):
+                # pin AMP for the trace, restore after (see run())
+                prev = amp_enabled()
+                enable_amp(_amp)
+                try:
+                    return _fn(state, feeds, keys)
+                finally:
+                    enable_amp(prev)
+
+            entry = jax.jit(fn, donate_argnums=(0,))
+            self._cache[key] = entry
+            if mon_on:
+                import jax.numpy as _jnp
+                rng0 = jax.vmap(jax.random.key)(
+                    _jnp.zeros((k,), _jnp.uint32))
+                _mon.on_compile(
+                    program, key, key[4],
+                    cost_fn=lambda: _step_costs_safe(
+                        fn, dict(state), dict(feeds_k), rng0),
+                    executor="pexe",
+                    tokens=_mon.tokens_in_feeds(feeds_k),
+                    devices=self.device_count)
+
+        base = program.random_seed * 1000003 + self._exe._rng_counter
+        self._exe._rng_counter += k
+        import jax.numpy as jnp
+        keys = jax.vmap(jax.random.key)(jnp.asarray(
+            [np.uint32(base + i) for i in range(k)]))
+
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        state_dev = {n: self._to_global(v, self._state_sharding(n))
+                     for n, v in state.items()}
+        dp_axis = None
+        if "dp" in self.mesh.axis_names:
+            dp_axis = "dp"
+        # dim 0 is the scan dim: shard each step's batch (dim 1) on dp
+        def feed_sharding(n, v):
+            if n in lod_keys or getattr(v, "ndim", 0) < 2 \
+                    or dp_axis is None:
+                return repl
+            return NamedSharding(self.mesh,
+                                 PartitionSpec(None, dp_axis))
+
+        feeds_dev = {n: self._to_global(v, feed_sharding(n, v))
+                     for n, v in feeds_k.items()}
+
+        window = max(1, int(get_flag("megastep_inflight")))
+        inflight = self.__dict__.setdefault("_inflight", [])
+        while len(inflight) >= window:
+            jax.block_until_ready(inflight.pop(0))
+
+        t0 = _time.perf_counter() if mon_on else 0.0
+        if mon_on:
+            timer = _mon.step_timer(self)
+            do_sync = timer.begin(t0)
+        fetches_k, new_state, guards_k, lods_k = entry(
+            state_dev, feeds_dev, keys)
+        if mon_on:
+            fb = _mon.feed_nbytes(feeds_k)
+            tk = _mon.tokens_in_feeds(feeds_k)
+            if do_sync:
+                jax.block_until_ready(fetches_k)
+                _mon.on_megastep(
+                    key, timer.end_synced(_time.perf_counter(), t0), k,
+                    feed_bytes=fb, tokens=tk, executor="pexe")
+            else:
+                _mon.on_megastep(key, _time.perf_counter() - t0, k,
+                                 feed_bytes=fb, tokens=tk,
+                                 executor="pexe", synced=False)
+
+        fetches_k = [self._local_value(v) for v in fetches_k]
+        lods_k = {n: self._local_value(v) for n, v in lods_k.items()}
+        guards_k = {n: self._local_value(v) for n, v in guards_k.items()}
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if check_nan:
+            _Exe._check_guards_steps(guards_k, k)
+        out = _Exe._split_step_fetches(fetch_names, fetches_k, lods_k,
+                                       k, return_numpy)
+        if check_nan:
+            for fi in out:
+                _Exe._check_nan_inf(fetch_names, fi)
+        if not return_numpy:
+            inflight.append(fetches_k)
+        return out
+
     def _run_impl(self, fetch_list, feed=None, feed_dict=None,
                   return_numpy=True):
         feed = dict(feed or feed_dict or {})
@@ -253,37 +487,13 @@ class ParallelExecutor:
                       + self._exe._rng_counter))
         self._exe._rng_counter += 1
 
-        # BCastParamsToGPUs parity: place state per its sharding once;
-        # jit keeps the placement on subsequent steps. On a multi-process
-        # (multi-host) mesh, host values become GLOBAL arrays via
-        # make_array_from_callback — every process passes the same full
-        # array (the reference's same-data-every-trainer contract) and
-        # keeps only its addressable shards.
-        multiproc = jax.process_count() > 1
-
-        def to_global(v, sh):
-            if isinstance(v, jax.Array):
-                if not v.is_fully_addressable or v.sharding == sh:
-                    # steady-state pass-through: step outputs keep their
-                    # committed (GSPMD-chosen) layouts; a multi-process
-                    # array cannot be resharded host-side anyway
-                    return v
-                # addressable but mis-placed (e.g. single-device startup
-                # output vs a tp sharding hint): lay it out per the hint
-                if multiproc:
-                    v = np.asarray(v)
-                else:
-                    return jax.device_put(v, sh)
-            if multiproc:
-                arr = np.asarray(v)
-                return jax.make_array_from_callback(
-                    arr.shape, sh, lambda idx, _a=arr: _a[idx])
-            return jax.device_put(v, sh)
-
-        state_dev = {n: to_global(v, self._state_sharding(n))
+        # place state per its sharding once; jit keeps the placement
+        # on subsequent steps (see _to_global)
+        state_dev = {n: self._to_global(v, self._state_sharding(n))
                      for n, v in state.items()}
         data_sh = self._data_sharding()
-        feeds_dev = {k: to_global(v, repl if k in lod_keys else data_sh)
+        feeds_dev = {k: self._to_global(v, repl if k in lod_keys
+                                        else data_sh)
                      for k, v in feed_arrays.items()}
 
         import time as _time
@@ -308,34 +518,10 @@ class ParallelExecutor:
                              feed_bytes=fb, tokens=tk, executor="pexe",
                              synced=False)
 
-        def local_value(v):
-            # a replicated output's sharding spans remote devices; its
-            # local shard IS the value. A dp-SHARDED fetch has no local
-            # full value: with FLAGS gather_sharded_fetches on, all-gather
-            # it so every process fetches the merged global array (the
-            # reference merged fetched tensors across devices,
-            # parallel_executor.cc:190-197); default stays the loud
-            # refusal rather than handing back 1/N of the batch.
-            if multiproc and isinstance(v, jax.Array) \
-                    and not v.is_fully_addressable:
-                if not v.sharding.is_fully_replicated:
-                    if get_flag("gather_sharded_fetches"):
-                        from jax.experimental import multihost_utils
-                        return np.asarray(
-                            multihost_utils.process_allgather(
-                                v, tiled=True))
-                    raise NotImplementedError(
-                        "fetching a cross-process SHARDED value (spec %s) "
-                        "is not supported — fetch replicated values "
-                        "(losses/metrics), gather in-graph first, or set "
-                        "PADDLE_TPU_GATHER_SHARDED_FETCHES=1 to all-"
-                        "gather at fetch time" % (v.sharding.spec,))
-                return np.asarray(list(v.addressable_shards)[0].data)
-            return v
-
-        fetches = [local_value(v) for v in fetches]
-        fetch_lods = {k: local_value(v) for k, v in fetch_lods.items()}
-        guards = {k: local_value(v) for k, v in guards.items()}
+        fetches = [self._local_value(v) for v in fetches]
+        fetch_lods = {k: self._local_value(v)
+                      for k, v in fetch_lods.items()}
+        guards = {k: self._local_value(v) for k, v in guards.items()}
         fetches = Executor._trim_fetches(fetch_names, fetches, fetch_lods)
         for n, v in new_state.items():
             scope.set(n, v)

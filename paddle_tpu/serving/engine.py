@@ -18,6 +18,13 @@ Orca (iteration-level scheduling) and vLLM (slot/block-managed caches):
     (``_prefill_chunk_slot``, one chunk per engine iteration) so one
     long prompt cannot stall the running batch; the admission policy is
     greedy fill by default with an optional wait-for-batch window.
+  * **Megastep decode** (ISSUE 7) — with ``megastep=K`` (flag
+    ``serving_megastep``) an iteration with no pending admissions or
+    prefills fuses K decode steps into ONE dispatch (``lax.scan`` over
+    the slot step), attacking the measured bs1 per-step dispatch floor
+    (PERF.md rounds 5/6) while staying token-identical; pending work
+    forces a K→1 boundary first. ``warmup()`` compiles both dispatch
+    paths before traffic.
 
 Every engine iteration is instrumented: monitor gauges/counters
 (``ptpu_serving_*``), a ``serving_step`` flight-recorder row carrying
@@ -158,10 +165,17 @@ class Engine:
     capacity; ``prefill_chunk`` the per-iteration prompt chunk length
     (flag ``serving_prefill_chunk``); ``admission_wait`` an optional
     wait-for-batch window in seconds applied when the engine is idle
-    (flag ``serving_admission_wait``; 0 = greedy fill)."""
+    (flag ``serving_admission_wait``; 0 = greedy fill); ``megastep``
+    fuses K decode iterations into ONE device dispatch whenever no
+    admissions or prefills are pending (flag ``serving_megastep``;
+    1 = one dispatch per decode step) — token-identical output with
+    K-1 fewer host round-trips per K tokens, at the cost of TTFT/TPOT
+    stamps coarsening to megastep granularity and admissions landing
+    at megastep boundaries (a pending admission forces a K→1 boundary
+    first)."""
 
     def __init__(self, model, slots=8, prefill_chunk=None,
-                 admission_wait=None, name="engine"):
+                 admission_wait=None, name="engine", megastep=None):
         if slots < 1:
             raise ValueError("slots must be >= 1, got %r" % (slots,))
         self.model = model
@@ -174,6 +188,17 @@ class Engine:
         self._admission_wait = float(
             admission_wait if admission_wait is not None
             else _flag("serving_admission_wait", 0.0))
+        # megastep K (ISSUE 7): decode iterations fused into ONE device
+        # dispatch (lax.scan over _step_impl) whenever no admissions or
+        # prefills are pending — K-1 fewer host round-trips per K
+        # tokens, attacking the measured bs1 per-step dispatch floor
+        # (PERF.md round 5). Admissions/retirement bookkeeping land at
+        # megastep boundaries; output stays token-identical (same
+        # per-iteration math, composed by scan). TTFT/TPOT attribution
+        # coarsens to megastep granularity: all K tokens of one
+        # dispatch land at the same host timestamp.
+        self._megastep = max(1, int(megastep if megastep is not None
+                                    else _flag("serving_megastep", 1)))
         self._cv = threading.Condition()
         self._queue = collections.deque()
         self._recs = [None] * self.slots   # loop-thread-only slot records
@@ -181,16 +206,50 @@ class Engine:
         self._error = None                 # loop-death cause, if any
         self._state = self._init_state()
         self._step_fn = jax.jit(self._step_impl, donate_argnums=0)
+        self._megastep_fn = None           # built lazily (jit) at K > 1
         self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=0)
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=0)
         self.stats = {"steps": 0, "decode_steps": 0, "tokens": 0,
                       "admissions": 0, "retirements": 0,
-                      "active_slot_steps": 0, "prefill_chunks": 0}
+                      "active_slot_steps": 0, "prefill_chunks": 0,
+                      "megastep_dispatches": 0}
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="ptpu-" + name)
         self._thread.start()
 
     # -- public API --------------------------------------------------------
+    def warmup(self):
+        """Compile every decode dispatch path up front: the single
+        step and, with ``megastep`` > 1, the fused K-step. One decode
+        over the ALL-INACTIVE slot state is semantically a no-op — the
+        active mask gates every cache write and every sampling-state
+        update — so this pays only the compiles. Call before
+        submitting traffic (the scheduler loop never touches decode
+        state while the queue and slots are empty). Without it a
+        megastep engine compiles the single-step path lazily on its
+        first mid-flight admission, stalling that iteration by a full
+        XLA compile."""
+        # the whole body holds _cv: a submit() racing in after the
+        # guard would otherwise let the loop thread activate a slot in
+        # self._state concurrently with warmup donating it (_step_fn
+        # donate_argnums=0) or have the trailing reassignment discard
+        # the activation — while _cv is held the loop stays parked in
+        # its idle wait and submits block until warmup finishes
+        with self._cv:
+            if self._queue or any(r is not None for r in self._recs):
+                raise RuntimeError(
+                    "warmup() must run before traffic is submitted "
+                    "(the scheduler loop owns the decode state once a "
+                    "request is in flight)")
+            state, _, _ = self._step_fn(self._state)
+            if self._megastep > 1:
+                if self._megastep_fn is None:
+                    self._megastep_fn = jax.jit(self._megastep_impl,
+                                                donate_argnums=0)
+                state, _, _ = self._megastep_fn(state)
+            self._state = state
+        return self
+
     def submit(self, prompt, max_new_tokens):
         """Enqueue one request; returns its Request handle. ``prompt``
         is the token-id prefix (≥ 1 token — pass ``[model.bos_id]`` for
@@ -292,6 +351,22 @@ class Engine:
         state["active"] = active & ~fin
         return state, emit, fin
 
+    def _megastep_impl(self, state):
+        """K decode iterations fused into one device program: a
+        lax.scan over ``_step_impl``, streaming each sub-iteration's
+        (emit, fin) rows out as ``[K, S]`` stacks. A slot that retires
+        at sub-iteration j goes inactive in the carry, so later
+        sub-iterations emit end_id for it and write nothing — the host
+        loop skips those rows, keeping output token-identical to K
+        single steps."""
+        def body(st, _):
+            st, emit, fin = self._step_impl(st)
+            return st, (emit, fin)
+
+        state, (emits, fins) = jax.lax.scan(
+            body, dict(state), None, length=self._megastep)
+        return state, emits, fins
+
     def _prefill_impl(self, state, slot, toks, start, n_valid):
         return self.model._prefill_chunk_slot(
             dict(state), slot, toks, start, n_valid)
@@ -326,9 +401,25 @@ class Engine:
                 self._error = e
             self._fail_all(e)
 
+    def _choose_k(self):
+        """Megastep K for THIS iteration: fuse only when nothing needs
+        a host decision between decode steps — no queued admissions, no
+        prefilling slot. A pending admission/prefill forces a K→1
+        boundary so scheduling latency never stretches to K steps."""
+        if self._megastep <= 1:
+            return 1
+        with self._cv:
+            if self._queue:
+                return 1
+        if any(r is not None and not r["live"] for r in self._recs):
+            return 1
+        return self._megastep
+
     def _step_once(self):
         """One engine iteration = admissions + one prefill chunk per
-        prefilling slot + one decode step over the active batch."""
+        prefilling slot + one decode dispatch (a single step, or a
+        fused K-step megastep when no admissions/prefills pend) over
+        the active batch."""
         finished = ()
         try:
             with _trc.span("engine.step") as sp:
@@ -340,7 +431,9 @@ class Engine:
                 # batching knob the operator chose
                 t0 = time.perf_counter()
                 self._advance_prefills()
-                active, finished = self._decode()
+                k = self._choose_k()
+                (active, finished, steps_run, emitted,
+                 trips) = self._decode(k)
                 with self._cv:
                     depth = len(self._queue)
                 self.stats["steps"] += 1
@@ -349,14 +442,23 @@ class Engine:
                 dt = time.perf_counter() - t0
                 # the span's DURATION covers the whole iteration
                 # (admission wait included); the dt attr carries the
-                # same post-admit figure as the recorder row so the
-                # SLO --spans surface gates the same quantity as --log
+                # PER-LOGICAL-STEP figure — the post-admit wall time
+                # divided by the scan trips the dispatch ran — same as
+                # the recorder row, so the SLO --spans surface gates
+                # the identical quantity as --log at any K. k = decode
+                # steps actually consumed (a drain-tail megastep can
+                # consume fewer than it dispatched).
+                per = dt / max(1, trips)
                 sp.annotate(active=active, admitted=admitted,
-                            retired=len(finished), queue=depth, dt=dt)
+                            retired=len(finished), queue=depth, dt=per,
+                            k=steps_run,
+                            **({"megastep_dt": dt} if trips > 1
+                               else {}))
                 _monrt.on_serving_step(
                     active=active, slots=self.slots, queue_depth=depth,
-                    emitted=active, admitted=admitted,
-                    retired=len(finished), engine=self.name, dt=dt)
+                    emitted=emitted, admitted=admitted,
+                    retired=len(finished), engine=self.name, dt=dt,
+                    k=steps_run, dispatched=trips)
                 for req, _ in finished:
                     self._retire_telemetry(req)
         finally:
@@ -475,45 +577,80 @@ class Engine:
                     np.int32(req.max_new))
                 rec["live"] = True
 
-    def _decode(self):
+    def _decode(self, k=1):
+        """One decode dispatch over the active batch: a single step
+        (k=1, the PR-5 path), or a fused K-step megastep — ONE device
+        program, one emit/fin fetch, K logical steps. Returns
+        (slots active at dispatch, finished, steps run, tokens
+        emitted)."""
         live = [s for s, r in enumerate(self._recs)
                 if r is not None and r["live"]]
         if not live:
-            return 0, []
-        self._state, emit, fin = self._step_fn(self._state)
-        emit, fin = np.asarray(emit), np.asarray(fin)
+            return 0, [], 0, 0, 0
+        if k > 1:
+            if self._megastep_fn is None:
+                self._megastep_fn = jax.jit(self._megastep_impl,
+                                            donate_argnums=0)
+            self._state, emits, fins = self._megastep_fn(self._state)
+            self.stats["megastep_dispatches"] += 1
+            emits, fins = np.asarray(emits), np.asarray(fins)
+        else:
+            self._state, emit, fin = self._step_fn(self._state)
+            # host-side axis add: [None] on the DEVICE array would
+            # dispatch a reshape per step on the k=1 hot path
+            emits = np.asarray(emit)[None]
+            fins = np.asarray(fin)[None]
         scores = None
         finished = []
+        emitted = 0
+        steps_run = 0
+        active0 = len(live)
         now = time.perf_counter()
-        for slot in live:
-            rec = self._recs[slot]
-            req = rec["req"]
-            req.tokens.append(int(emit[slot]))
-            if req.t_first_token is None:
-                req.t_first_token = now
-                try:
-                    # guarded: by this point in the loop EARLIER slots
-                    # may already be popped into the local `finished`
-                    # — an exception escaping here (span-log write)
-                    # would lose them to both _step_once's finally and
-                    # _fail_all, stranding their result() forever
-                    with _trc.child_span(
-                            "request.first_token", req._span,
-                            step_span=self._step_span_id()):
-                        pass            # zero-width timeline mark
-                    req._span.annotate(ttft=req.ttft)
-                except Exception:
-                    pass
-            if fin[slot]:
-                req.t_retire = now
-                if scores is None:      # one [S] fetch per iteration
-                    scores = np.asarray(self._state["score"])
-                finished.append((req, float(scores[slot])))
-                self._recs[slot] = None
-        self.stats["decode_steps"] += 1
-        self.stats["active_slot_steps"] += len(live)
-        self.stats["tokens"] += len(live)
-        return len(live), finished
+        # replay the K sub-iterations host-side: a slot retired at
+        # sub-iteration j stops consuming rows (its later emits are
+        # end_id filler from the inactive carry)
+        for j in range(emits.shape[0]):
+            if not live:
+                break
+            steps_run += 1
+            self.stats["decode_steps"] += 1
+            self.stats["active_slot_steps"] += len(live)
+            for slot in list(live):
+                rec = self._recs[slot]
+                req = rec["req"]
+                req.tokens.append(int(emits[j, slot]))
+                emitted += 1
+                if req.t_first_token is None:
+                    req.t_first_token = now
+                    try:
+                        # guarded: by this point in the loop EARLIER
+                        # slots may already be popped into the local
+                        # `finished` — an exception escaping here
+                        # (span-log write) would lose them to both
+                        # _step_once's finally and _fail_all,
+                        # stranding their result() forever
+                        with _trc.child_span(
+                                "request.first_token", req._span,
+                                step_span=self._step_span_id()):
+                            pass        # zero-width timeline mark
+                        req._span.annotate(ttft=req.ttft)
+                    except Exception:
+                        pass
+                if fins[j, slot]:
+                    req.t_retire = now
+                    if scores is None:  # one [S] fetch per dispatch
+                        # safe across sub-iterations: a retired slot's
+                        # score is frozen by its inactive mask
+                        scores = np.asarray(self._state["score"])
+                    finished.append((req, float(scores[slot])))
+                    self._recs[slot] = None
+                    live.remove(slot)
+        self.stats["tokens"] += emitted
+        # trips = scan trips the DEVICE ran this dispatch (a drain-tail
+        # megastep may consume fewer: every live slot can retire before
+        # the last sub-iteration, the rest is inactive filler) — per-
+        # step latency must divide by trips, not steps consumed
+        return active0, finished, steps_run, emitted, emits.shape[0]
 
     def _fail_all(self, err):
         with self._cv:

@@ -1,0 +1,350 @@
+"""Megastep execution (ISSUE 7): K logical steps fused into ONE device
+dispatch.
+
+The contract pinned here is the tentpole acceptance story:
+``Executor.run_steps`` (and the ParallelExecutor twin) advance K real
+training steps — forward, backward AND optimizer/persistable-state
+update — in one ``lax.scan`` dispatch, BITWISE-identical to K
+sequential ``run()`` calls (per-step RNG stream included), with
+per-step fetches/NaN-guards streamed out of the scan, LoD feeds riding
+the host pre-stack path, feed-plan-cache hits accounted, the
+``[k, ...]`` DeviceLoader staging stack consumable directly, and the
+monitor/trace tier reporting PER-LOGICAL-STEP figures at any K.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags
+from paddle_tpu.core import unique_name
+from paddle_tpu.monitor import runtime as monrt
+
+
+def _build_mlp(prefix, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard(prefix):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="tanh")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+    return main, scope, exe, loss
+
+
+def _mlp_feeds(n=4, batch=8):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(batch, 8).astype(np.float32),
+             "y": rng.rand(batch, 1).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _params(main, scope):
+    return {v.name: np.asarray(scope.find_var(v.name)).copy()
+            for v in main.global_block().vars.values()
+            if v.persistable and scope.find_var(v.name) is not None}
+
+
+@pytest.fixture(scope="module")
+def seq_baseline():
+    """Four sequential run() steps on the shared feed set — the
+    identity reference every K compares against."""
+    feeds = _mlp_feeds()
+    main, scope, exe, loss = _build_mlp("ms_")
+    losses = [np.asarray(exe.run(main, feed=f, fetch_list=[loss],
+                                 scope=scope)[0]) for f in feeds]
+    return feeds, losses, _params(main, scope)
+
+
+# -- train-path identity matrix (the tentpole contract) --------------------
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_run_steps_bitwise_identical_to_sequential(k, seq_baseline):
+    """4 logical steps at megastep K: every per-step loss and every
+    final parameter is BITWISE equal to the 4 sequential run() calls
+    (same feeds, same per-step RNG stream)."""
+    feeds, seq_losses, seq_params = seq_baseline
+    main, scope, exe, loss = _build_mlp("ms_")
+    mega_losses = []
+    for i in range(0, len(feeds), k):
+        out = exe.run_steps(main, feeds=feeds[i:i + k],
+                            fetch_list=[loss], scope=scope)
+        assert len(out) == k
+        mega_losses += [np.asarray(o[0]) for o in out]
+    for i, (a, b) in enumerate(zip(seq_losses, mega_losses)):
+        np.testing.assert_array_equal(a, b, err_msg="step %d" % i)
+    params = _params(main, scope)
+    assert params.keys() == seq_params.keys()
+    for n in params:
+        np.testing.assert_array_equal(params[n], seq_params[n],
+                                      err_msg=n)
+
+
+def _lod(arr, lengths):
+    t = fluid.LoDTensor(np.asarray(arr))
+    t.set_recursive_sequence_lengths([list(lengths)])
+    return t
+
+
+def _build_lod_net(prefix):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope), \
+            unique_name.guard(prefix):
+        x = fluid.layers.data("x", [4], lod_level=1)
+        h = fluid.layers.fc(x, 8, act="tanh")
+        pooled = fluid.layers.sequence_pool(h, "max")
+        loss = fluid.layers.mean(pooled)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+    return main, scope, exe, loss
+
+
+def _lod_feeds():
+    """Two LoD batches whose lengths DIFFER but normalize to one
+    signature (same total 8, same MAXLEN bucket) — the shared-signature
+    contract run_steps documents."""
+    rng = np.random.RandomState(3)
+    return [{"x": _lod(rng.rand(8, 4).astype(np.float32), lens)}
+            for lens in ([3, 5], [5, 3])]
+
+
+def test_run_steps_lod_feeds_identical():
+    feeds = _lod_feeds()
+    m1, s1, e1, l1 = _build_lod_net("ml_")
+    seq = [np.asarray(e1.run(m1, feed=f, fetch_list=[l1],
+                             scope=s1)[0]) for f in feeds]
+    m2, s2, e2, l2 = _build_lod_net("ml_")
+    out = e2.run_steps(m2, feeds=feeds, fetch_list=[l2], scope=s2)
+    for i, o in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(o[0]), seq[i],
+                                      err_msg="step %d" % i)
+    p1, p2 = _params(m1, s1), _params(m2, s2)
+    for n in p1:
+        np.testing.assert_array_equal(p2[n], p1[n], err_msg=n)
+
+
+def test_run_steps_rejects_mixed_signatures():
+    rng = np.random.RandomState(4)
+    main, scope, exe, loss = _build_mlp("ms_")
+    feeds = [{"x": rng.rand(8, 8).astype(np.float32),
+              "y": rng.rand(8, 1).astype(np.float32)},
+             {"x": rng.rand(4, 8).astype(np.float32),
+              "y": rng.rand(4, 1).astype(np.float32)}]
+    with pytest.raises(ValueError, match="ONE compiled-step signature"):
+        exe.run_steps(main, feeds=feeds, fetch_list=[loss], scope=scope)
+
+
+def test_run_steps_arg_validation():
+    main, scope, exe, loss = _build_mlp("ms_")
+    with pytest.raises(ValueError, match="k >= 1"):
+        exe.run_steps(main, feeds=[], fetch_list=[loss], scope=scope)
+    with pytest.raises(ValueError, match="k="):
+        exe.run_steps(main, feeds={"x": np.zeros((2, 8, 8))},
+                      fetch_list=[loss], scope=scope)
+    with pytest.raises(ValueError, match="k=3 but 2"):
+        exe.run_steps(main, feeds=_mlp_feeds(2), k=3,
+                      fetch_list=[loss], scope=scope)
+
+
+def test_run_steps_prestacked_rejects_lod():
+    main, scope, exe, loss = _build_lod_net("ml_")
+    t = _lod(np.zeros((8, 4), np.float32), [3, 5])
+    with pytest.raises(ValueError, match="LIST of per-step feed dicts"):
+        exe.run_steps(main, feeds={"x": t}, k=2, fetch_list=[loss],
+                      scope=scope)
+
+
+def test_nan_guard_names_the_failing_logical_step():
+    flags.set_flag("check_nan_inf", True)
+    try:
+        main, scope, exe, loss = _build_mlp("ms_")
+        feeds = _mlp_feeds(3)
+        feeds[1] = dict(feeds[1])
+        bad = feeds[1]["x"].copy()
+        bad[0, 0] = np.nan
+        feeds[1]["x"] = bad
+        with pytest.raises(FloatingPointError,
+                           match="logical step 1 of 3"):
+            exe.run_steps(main, feeds=feeds, fetch_list=[loss],
+                          scope=scope)
+    finally:
+        flags.set_flag("check_nan_inf", None)
+
+
+def test_feed_plan_cache_accounting_across_megastep():
+    """K same-signature per-step feeds derive ONE plan: the first feed
+    misses, the remaining K-1 hit (PR-5 counter contract extended)."""
+    main, scope, exe, loss = _build_mlp("ms_")
+    feeds = _mlp_feeds(3)
+    n0, h0 = monrt.FEED_NORMALIZATIONS.value(), \
+        monrt.FEED_PLAN_HITS.value()
+    exe.run_steps(main, feeds=feeds, fetch_list=[loss], scope=scope)
+    assert monrt.FEED_NORMALIZATIONS.value() == n0 + 1
+    assert monrt.FEED_PLAN_HITS.value() == h0 + 2
+    # a second megastep on the same signature is all hits
+    exe.run_steps(main, feeds=feeds, fetch_list=[loss], scope=scope)
+    assert monrt.FEED_NORMALIZATIONS.value() == n0 + 1
+    assert monrt.FEED_PLAN_HITS.value() == h0 + 5
+
+
+# -- async double-buffered dispatch ----------------------------------------
+
+def test_async_window_returns_device_fetches():
+    """return_numpy=False keeps fetches device-resident and async; the
+    double-buffer window (megastep_inflight) bounds un-fetched
+    dispatches without changing results; window=1 (serialized) matches
+    window=2 bitwise."""
+    import jax
+    feeds = _mlp_feeds(4)
+    vals = {}
+    for window in (2, 1):
+        flags.set_flag("megastep_inflight", window)
+        try:
+            main, scope, exe, loss = _build_mlp("ms_")
+            outs = []
+            for i in range(0, 4, 2):
+                outs.append(exe.run_steps(
+                    main, feeds=feeds[i:i + 2], fetch_list=[loss],
+                    scope=scope, return_numpy=False))
+            assert len(exe._inflight) == min(window, 2)
+            flat = [v for out in outs for (v,) in out]
+            assert all(isinstance(v, jax.Array) for v in flat)
+            vals[window] = [np.asarray(v) for v in flat]
+        finally:
+            flags.set_flag("megastep_inflight", None)
+    np.testing.assert_array_equal(vals[1], vals[2])
+
+
+# -- DeviceLoader staging stack --------------------------------------------
+
+def test_device_loader_megabatches_feed_run_steps():
+    """The [k, ...] staging stack the loader builds is directly
+    consumable by run_steps(feeds=stack, k=k), matching the host
+    list-of-feeds path bitwise; a trailing short group keeps its true
+    length."""
+    from paddle_tpu.reader.device_loader import DeviceLoader
+    feeds = _mlp_feeds(3)
+    stacks = list(DeviceLoader(iter(feeds)).megabatches(2))
+    assert len(stacks) == 2
+    assert stacks[0]["x"].shape == (2, 8, 8)
+    assert stacks[1]["x"].shape == (1, 8, 8)   # trailing group
+    m1, s1, e1, l1 = _build_mlp("ms_")
+    seq = [np.asarray(e1.run(m1, feed=f, fetch_list=[l1],
+                             scope=s1)[0]) for f in feeds]
+    m2, s2, e2, l2 = _build_mlp("ms_")
+    got = []
+    for st in stacks:
+        k = int(np.shape(st["x"])[0])
+        got += [np.asarray(o[0]) for o in e2.run_steps(
+            m2, feeds=st, k=k, fetch_list=[l2], scope=s2)]
+    np.testing.assert_array_equal(got, seq)
+
+
+def test_device_loader_megabatches_reject_lod():
+    from paddle_tpu.reader.device_loader import DeviceLoader
+    feeds = [{"x": _lod(np.zeros((8, 4), np.float32), [3, 5])}]
+    with pytest.raises(ValueError, match="per-step feed dicts"):
+        list(DeviceLoader(iter(feeds)).megabatches(2))
+
+
+def test_device_loader_passes_lod_feeds_through_intact():
+    """ISSUE-7 satellite fix: the plain prefetch path must yield LoD
+    feeds UNTOUCHED (previously np.asarray silently stripped the LoD),
+    so the consuming executor's own normalization still sees lengths."""
+    from paddle_tpu.reader.device_loader import DeviceLoader
+    t = _lod(np.random.RandomState(0).rand(8, 4).astype(np.float32),
+             [3, 5])
+    [batch] = list(DeviceLoader(iter([{"x": t, "d": np.ones(
+        (2, 3), np.float32)}])))
+    assert isinstance(batch["x"], fluid.LoDTensor)
+    assert batch["x"].recursive_sequence_lengths() == [[3, 5]]
+    import jax
+    assert isinstance(batch["d"], jax.Array)
+
+
+# -- ParallelExecutor twin -------------------------------------------------
+
+def test_parallel_run_steps_identical_and_rejects_accum():
+    from paddle_tpu import parallel
+    feeds = _mlp_feeds(4)
+
+    def run(mode):
+        main, scope, exe, loss = _build_mlp("ms_")
+        pexe = fluid.ParallelExecutor(loss_name=loss.name,
+                                      main_program=main, scope=scope)
+        if mode == "seq":
+            losses = [np.asarray(pexe.run([loss], feed=f)[0])
+                      for f in feeds]
+        else:
+            losses = [np.asarray(o[0]) for o in
+                      pexe.run_steps([loss], feeds=feeds)]
+        return losses, _params(main, scope), pexe
+
+    seq, pseq, _ = run("seq")
+    mega, pmega, pexe = run("mg")
+    np.testing.assert_array_equal(mega, seq)
+    for n in pseq:
+        np.testing.assert_array_equal(pmega[n], pseq[n], err_msg=n)
+
+    strat = parallel.DistributedStrategy(gradient_accumulation_steps=2)
+    main, scope, exe, loss = _build_mlp("ms_")
+    pexe2 = fluid.ParallelExecutor(loss_name=loss.name,
+                                   main_program=main, scope=scope,
+                                   strategy=strat)
+    with pytest.raises(ValueError, match="gradient_accumulation"):
+        pexe2.run_steps([loss], feeds=feeds[:2])
+
+
+# -- monitor / trace integration -------------------------------------------
+
+def test_megastep_monitor_counters_and_recorder_row(tmp_path):
+    from paddle_tpu import monitor
+    main, scope, exe, loss = _build_mlp("ms_")
+    feeds = _mlp_feeds(2)
+    log = str(tmp_path / "mega.jsonl")
+    d0 = monrt.MEGASTEP_DISPATCHES.value(executor="exe")
+    s0 = monrt.MEGASTEP_STEPS.value(executor="exe")
+    st0 = monrt.STEPS.value(executor="exe")
+    monitor.enable(log_path=log)
+    try:
+        exe.run_steps(main, feeds=feeds, fetch_list=[loss],
+                      scope=scope)
+    finally:
+        monitor.disable()
+    assert monrt.MEGASTEP_DISPATCHES.value(executor="exe") == d0 + 1
+    assert monrt.MEGASTEP_STEPS.value(executor="exe") == s0 + 2
+    # the fusion is visible as steps advanced vs dispatches: 2 logical
+    # steps, ONE host dispatch
+    assert monrt.STEPS.value(executor="exe") == st0 + 2
+    rows = [r for r in monitor.read_jsonl(log) if r["ev"] == "step"]
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["k"] == 2 and r["megastep_dt"] > 0
+    # dt is the PER-LOGICAL-STEP figure (megastep wall time / K)
+    assert abs(r["dt"] - r["megastep_dt"] / 2) < 1e-9
+
+
+def test_megastep_trace_span_carries_k(tmp_path):
+    from paddle_tpu import monitor
+    from paddle_tpu.trace import runtime as trt
+    main, scope, exe, loss = _build_mlp("ms_")
+    tlog = str(tmp_path / "spans.jsonl")
+    trt.enable(log_path=tlog, sample_rate=1.0, proc="mega-test")
+    try:
+        exe.run_steps(main, feeds=_mlp_feeds(2), fetch_list=[loss],
+                      scope=scope)
+    finally:
+        trt.disable()
+    spans = [r for r in monitor.read_jsonl(tlog) if r["ev"] == "span"
+             and r["name"] == "exe.step"]
+    assert spans and spans[-1]["attrs"]["k"] == 2

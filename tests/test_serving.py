@@ -167,6 +167,58 @@ def test_engine_validation_and_close(lm, eng4):
     assert monrt.SERVING_FAILURES.value() - f0 >= 1
 
 
+def test_engine_megastep_token_identical_and_telemetry(rng, lm,
+                                                       tmp_path):
+    """ISSUE-7 serving acceptance: a megastep engine (K=4 decode
+    iterations fused into ONE dispatch when no admissions/prefills
+    pend) stays token-identical to the sequential baseline — across
+    slot recycling, chunked prefill and a mid-flight admission that
+    forces a K→1 boundary — while serving_step rows report the
+    per-logical-step dt with the fused k and the megastep counters
+    tick."""
+    from paddle_tpu import monitor
+    reqs = _requests(rng, 6, min_new=8, max_new=16)
+    seq = serving.sequential_generate(lm, reqs)
+    mlog = str(tmp_path / "mega.jsonl")
+    d0 = monrt.MEGASTEP_DISPATCHES.value(executor="mega")
+    monitor.enable(log_path=mlog)
+    try:
+        with serving.Engine(lm, slots=2, prefill_chunk=4, megastep=4,
+                            name="mega") as eng:
+            # warmup compiles BOTH dispatch paths on the all-inactive
+            # state without touching decode semantics
+            eng.warmup()
+            out = eng.generate_many([p for p, _ in reqs[:4]],
+                                    [m for _, m in reqs[:4]])
+            # mid-flight admission: submit while the engine decodes —
+            # the pending request forces the next dispatch back to K=1
+            first = [eng.submit(p, m) for p, m in reqs[4:5]]
+            with pytest.raises(RuntimeError, match="before traffic"):
+                eng.warmup()        # request queued or in flight
+            time.sleep(0.02)
+            rest = [eng.submit(p, m) for p, m in reqs[5:]]
+            out += [h.result(timeout=60) for h in first + rest]
+            assert eng.stats["megastep_dispatches"] > 0
+            # fusion really reduced dispatches: decode_steps advanced
+            # more than once per engine iteration overall
+            assert eng.stats["decode_steps"] > eng.stats["steps"]
+    finally:
+        monitor.disable()
+    _assert_identical(seq, out)
+    assert monrt.MEGASTEP_DISPATCHES.value(executor="mega") > d0
+    rows = [r for r in monitor.read_jsonl(mlog)
+            if r["ev"] == "serving_step"]
+    fused = [r for r in rows if r.get("k", 1) > 1]
+    assert fused, "no fused serving_step rows recorded"
+    for r in fused:
+        assert r["k"] > 1 and r["megastep_dt"] > 0
+        # dt is per logical step: megastep_dt / trips DISPATCHED (a
+        # drain-tail megastep consumes fewer steps than it dispatched,
+        # but the device still ran every scan trip in megastep_dt)
+        assert r["dispatched"] >= r["k"]
+        assert abs(r["dt"] - r["megastep_dt"] / r["dispatched"]) < 1e-9
+
+
 # -- telemetry: metrics, flight recorder, trace ----------------------------
 
 def test_serving_metrics_recorder_and_trace(rng, eng4, tmp_path):
