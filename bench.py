@@ -367,6 +367,158 @@ def main():
 
     megastep_summary = guarded("megastep-probe", megastep_probe, errors)
 
+    def fleet_probe():
+        """ISSUE-8 serving-fleet probe, CPU-pinned like the serving
+        probe: (a) DISARMED router overhead — direct single-Engine
+        generate_many vs the same mixed request set through KV-registry
+        + Router + replica RPC, interleaved A/B windows (PR-4
+        protocol), per-request p50/p95 added latency stamped; (b) a
+        small ARMED pass (seeded replica kill mid-traffic + supervisor
+        respawn) stamping resubmission counts and the exactly-once/
+        token-identity verdict."""
+        import jax
+        import numpy as np
+        from paddle_tpu import serving
+        from paddle_tpu.distributed.membership import KVServer, KVClient
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.models.transformer_infer import TransformerLMInfer
+        from paddle_tpu.resilience import faults
+        from paddle_tpu.serving import fleet
+        prev = jax.config.jax_default_device
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        try:
+            _fresh()
+            scope = fluid.global_scope()
+            # decode-bound shape: the router's per-request cost (SUBM
+            # round trip + delivery ack) must be measured against real
+            # decode work, the production ratio — on a dispatch-bound
+            # toy model the host RPC chatter IS the bottleneck and the
+            # figure measures core contention, not the front door
+            T.transformer_lm(vocab_size=256, max_len=224, n_layer=4,
+                             n_head=4, d_model=256, d_inner=1024)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            lm = TransformerLMInfer(fluid.default_main_program(), scope,
+                                    4, 4, 256, 224)
+            rng = np.random.RandomState(0)
+            reqs = []
+            for _ in range(16):
+                plen = int(rng.randint(1, 9))
+                prompt = [1] + rng.randint(3, 256, plen - 1).tolist()
+                reqs.append((prompt, int(rng.randint(64, 129))))
+            prompts = [p for p, _ in reqs]
+            news = [m for _, m in reqs]
+
+            eng = serving.Engine(lm, slots=4, prefill_chunk=8,
+                                 name="fleet-direct")
+            kvs = KVServer(sweep_interval=0.05).start()
+            kv = KVClient(kvs.endpoint)
+            cells = [fleet.Replica(kv, lm, desired=1, slots=4,
+                                   prefill_chunk=8, ttl=0.5)]
+            router = fleet.Router(kvs.endpoint, window=8,
+                                  refresh_interval=0.05)
+            router.wait_for_replicas(1)
+
+            def win_direct():
+                t0 = time.perf_counter()
+                handles = [eng.submit(p, m)
+                           for p, m in zip(prompts, news)]
+                out = [h.result(timeout=120) for h in handles]
+                dt = time.perf_counter() - t0
+                lats = sorted(h.t_retire - h.t_enqueue
+                              for h in handles)
+                return dt, lats, out
+
+            def win_routed():
+                t0 = time.perf_counter()
+                handles = [router.submit(p, m)
+                           for p, m in zip(prompts, news)]
+                out = [h.result(timeout=120) for h in handles]
+                dt = time.perf_counter() - t0
+                lats = sorted(h.latency() for h in handles)
+                return dt, lats, out
+
+            win_direct(), win_routed()        # warm every compile
+            wins, a_dt, b_dt, a_lat, b_lat = 3, [], [], [], []
+            base, identical = None, True
+            for _ in range(wins):             # interleaved A/B
+                dt, lats, out = win_direct()
+                a_dt.append(dt)
+                a_lat.append(lats)
+                base = out
+                dt, lats, out = win_routed()
+                b_dt.append(dt)
+                b_lat.append(lats)
+                # accumulated across EVERY window — a divergence in an
+                # early window must not be masked by a clean last one
+                identical = identical and all(
+                    bt == rt for (bt, _), (rt, _) in zip(base, out))
+            ma, spa, _ = agg(a_dt, nd=4)
+            mb, spb, _ = agg(b_dt, nd=4)
+
+            def pct(ls, q):
+                import statistics
+                per = [s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+                       for s in ls]
+                return statistics.median(per)
+
+            # armed pass: seeded kill mid-traffic + respawn; every
+            # accepted request completes exactly once, token-identical
+            def spawn():
+                return fleet.Replica(kv, lm, desired=2, slots=4,
+                                     prefill_chunk=8, ttl=0.4)
+            cells.append(spawn())             # 2nd replica for the kill
+            # threshold relative to the warm-up traffic already
+            # accepted, so the kill fires mid-way through the ARMED
+            # pass (the fault counts SUBM admissions)
+            plan = faults.arm(
+                {"kill": [{"target": "replica:0",
+                           "after": cells[0].server._accepted + 4}]},
+                seed=1301)
+            sup = fleet.Supervisor(kv, spawn, desired=2,
+                                   interval=0.1).start()
+            chaos = router.generate_many(prompts, news, timeout=120)
+            chaos_ok = all(bt == ct for (bt, _), (ct, _)
+                           in zip(base, chaos))
+            faults.disarm()
+            probe = {
+                "config": "transformer_lm 4L/d256, 16 mixed reqs "
+                          "(64-128 new tokens), slots=4 (CPU pin)",
+                "windows": wins,
+                "direct_s": round(ma, 4), "direct_spread_pct": spa,
+                "routed_s": round(mb, 4), "routed_spread_pct": spb,
+                "router_overhead_pct": round(100 * (mb - ma) / ma, 2),
+                "direct_p50_ms": round(1000 * pct(a_lat, 0.5), 2),
+                "routed_p50_ms": round(1000 * pct(b_lat, 0.5), 2),
+                "added_p50_ms": round(1000 * (pct(b_lat, 0.5)
+                                              - pct(a_lat, 0.5)), 2),
+                "added_p95_ms": round(1000 * (pct(b_lat, 0.95)
+                                              - pct(a_lat, 0.95)), 2),
+                "identical": bool(identical),
+                "chaos_identical": bool(chaos_ok),
+                "chaos_resubmissions": router.stats["resubmissions"],
+                "chaos_evictions": dict(router.stats["evictions"]),
+                "chaos_respawns": sup.respawns,
+                "kill_fired": ("kill", "replica:0") in plan.trips,
+            }
+            sup.stop()
+            router.close()
+            for c in cells + sup.cells:
+                try:
+                    c.shutdown()
+                except Exception:
+                    pass
+            eng.close()
+            kv.shutdown_server()
+            kv.close()
+            print("fleet probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            faults.disarm()
+            jax.config.update("jax_default_device", prev)
+
+    fleet_summary = guarded("fleet-probe", fleet_probe, errors)
+
     ips, res_spread, res_samples = agg(res_s)
     large_flops_tok = flops_per_token(L=8, D=1024, FFN=4096, T=1024,
                                       V=8192)
@@ -424,6 +576,12 @@ def main():
         # megastep K-sweep stamp (ISSUE 7): K=1 vs K=8 interleaved
         # A/B medians on the dispatch-bound train shape
         out["megastep"] = megastep_summary
+    if fleet_summary is not None:
+        # serving-fleet stamp (ISSUE 8): disarmed router overhead
+        # (interleaved A/B vs direct engine, per-request p50/p95 added
+        # latency) + the armed kill pass's resubmission/exactly-once
+        # verdict
+        out["fleet"] = fleet_summary
     try:
         # platform stamp: a chipless (CPU-pinned) rehearsal round must
         # never be read as a chip round's throughput record

@@ -22,6 +22,7 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.monitor.watch",
     "paddle_tpu.serving",
     "paddle_tpu.serving.engine",
+    "paddle_tpu.serving.fleet",
     "paddle_tpu.reader",
     "paddle_tpu.reader.device_loader",
     "paddle_tpu.slo",

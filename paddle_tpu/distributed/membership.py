@@ -26,8 +26,9 @@ from ..monitor import metrics as _metrics
 from ..trace import clock as _clock
 from ..trace import runtime as _trace
 
-__all__ = ["KVServer", "KVClient", "register_pserver", "wait_for_pservers",
-           "TrainerLease"]
+__all__ = ["KVServer", "KVClient", "register_endpoint",
+           "wait_for_endpoints", "live_endpoints", "role_prefix",
+           "register_pserver", "wait_for_pservers", "TrainerLease"]
 
 _REG = _metrics.registry()
 _HEARTBEATS = _REG.counter("ptpu_lease_heartbeats_total",
@@ -372,33 +373,69 @@ class _Lease:
             pass
 
 
-def register_pserver(kv, desired, my_endpoint, ttl=1.0):
-    """Claim one of the `desired` pserver index slots with CAS under a
-    TTL lease (etcd_client.go:43-100). Returns (index, lease). A crashed
-    server's slot frees itself when its lease expires; the replacement
-    claims the SAME index and recovers that shard's checkpoint."""
-    deadline = time.time() + 30.0
+def role_prefix(role):
+    """KV key prefix for a role's slot registry ('ps' -> '/ps/')."""
+    return "/%s/" % role.strip("/")
+
+
+def register_endpoint(kv, role, desired, my_endpoint, ttl=1.0,
+                      timeout=30.0):
+    """Claim one of the `desired` index slots of a ROLE with CAS under
+    a TTL lease (etcd_client.go:43-100, generalized beyond pservers so
+    serving replicas — and any future role — share one registration
+    path). Returns (index, lease). A crashed holder's slot frees itself
+    when its lease expires; the replacement claims the SAME index and
+    recovers that member's state (checkpoint shard, serving engine,
+    ...)."""
+    prefix = role_prefix(role)
+    deadline = time.time() + timeout
     while time.time() < deadline:
         for i in range(desired):
-            key = PS_PREFIX + str(i)
+            key = prefix + str(i)
             if kv.cas(key, None, my_endpoint, ttl=ttl):
                 return i, _Lease(kv, key, ttl, value=my_endpoint)
         time.sleep(ttl / 4.0)
-    raise TimeoutError("no free pserver slot out of %d" % desired)
+    raise TimeoutError("no free %s slot out of %d" % (role, desired))
+
+
+def wait_for_endpoints(kv, role, desired, timeout=30.0):
+    """Rendezvous: block until all `desired` slots of a role are
+    claimed; returns the endpoint list ordered by slot index."""
+    prefix = role_prefix(role)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        claimed = kv.list(prefix)
+        if len(claimed) >= desired and all(
+                prefix + str(i) in claimed for i in range(desired)):
+            return [claimed[prefix + str(i)] for i in range(desired)]
+        time.sleep(0.05)
+    raise TimeoutError("%s rendezvous: %d claimed of %d desired"
+                       % (role, len(kv.list(prefix)), desired))
+
+
+def live_endpoints(kv, role):
+    """Current slot -> registered value map for a role (whatever leases
+    are alive NOW — no rendezvous wait). Callers that tombstone slots
+    (serving.fleet eviction writes a non-endpoint marker) filter the
+    values themselves."""
+    prefix = role_prefix(role)
+    out = {}
+    for k, v in kv.list(prefix).items():
+        try:
+            out[int(k[len(prefix):])] = v
+        except ValueError:
+            pass
+    return out
+
+
+def register_pserver(kv, desired, my_endpoint, ttl=1.0):
+    """Thin pserver alias over register_endpoint (role 'ps')."""
+    return register_endpoint(kv, "ps", desired, my_endpoint, ttl=ttl)
 
 
 def wait_for_pservers(kv, desired, timeout=30.0):
-    """Rendezvous: block until all `desired` slots are claimed; returns
-    the endpoint list ordered by slot index."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        claimed = kv.list(PS_PREFIX)
-        if len(claimed) >= desired and all(
-                PS_PREFIX + str(i) in claimed for i in range(desired)):
-            return [claimed[PS_PREFIX + str(i)] for i in range(desired)]
-        time.sleep(0.05)
-    raise TimeoutError("pserver rendezvous: %d claimed of %d desired"
-                       % (len(kv.list(PS_PREFIX)), desired))
+    """Thin pserver alias over wait_for_endpoints (role 'ps')."""
+    return wait_for_endpoints(kv, "ps", desired, timeout=timeout)
 
 
 class TrainerLease:

@@ -9,6 +9,16 @@ multi-process tests can rendezvous on an ephemeral port.
 The wire format is numpy-native (header + raw buffers), not pickle-of-
 arbitrary-objects, so a malicious peer can't execute code via the
 deserializer.
+
+Verb map over this one frame protocol (every tier rides the same
+``_send_msg``/``_recv_msg``, so fault injection, trace-context
+propagation and the retry policy apply to all of them for free):
+
+    pserver   SEND PUT GET PRFT BARR CHNK EXIT
+    master    GETT DONE FAIL PING        (distributed/master.py)
+    kv store  PUT GET CAS DEL CAD LIST LEAS   (membership.py)
+    serving   SUBM POLL CANC STAT        (serving/fleet.py replicas)
+    all       CLKS                       (trace clock probes)
 """
 
 import itertools

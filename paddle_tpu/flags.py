@@ -31,7 +31,9 @@ Registered flags:
                         feed buffers across same-signature run() calls
   serving*        —     paddle_tpu.serving continuous-batching engine
                         knobs (prefill chunk length, admission window,
-                        fused decode megastep K)
+                        fused decode megastep K) and serving.fleet
+                        router knobs (per-replica in-flight window,
+                        global shed bound, stall-watchdog deadline)
   megastep_inflight int Executor.run_steps async dispatch window depth
                         (2 = double buffering)
   slo_spec        str   default SLO spec JSON for python -m
@@ -188,6 +190,22 @@ _register("serving_megastep", int, 1,
           "retirement bookkeeping land at megastep boundaries; output "
           "stays token-identical to the K=1 engine. 1 = one dispatch "
           "per decode step (the PR-5 behavior)")
+_register("serving_fleet_window", int, 8,
+          "serving.fleet Router per-replica in-flight window "
+          "(backpressure): at most this many journaled requests are "
+          "dispatched to one replica at a time; the rest queue "
+          "router-side")
+_register("serving_fleet_queue", int, 64,
+          "serving.fleet Router global queue bound (load shedding): "
+          "once this many requests await dispatch, submit() fast-fails "
+          "with the typed Overloaded error, counted against the SLO "
+          "error budget")
+_register("serving_fleet_stall_timeout", float, 2.0,
+          "serving.fleet Router response-deadline watchdog: a replica "
+          "that answers no verb for this long (retry deadline "
+          "included) is evicted from dispatch, its registry slot "
+          "tombstoned for the supervisor, and its unfinished requests "
+          "re-submitted to a survivor")
 _register("megastep_inflight", int, 2,
           "Executor.run_steps async dispatch window: how many "
           "un-fetched megastep dispatches may be in flight before the "

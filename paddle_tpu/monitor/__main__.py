@@ -3,6 +3,8 @@
     python -m paddle_tpu.monitor run.jsonl [--json]
     python -m paddle_tpu.monitor watch run.jsonl [--interval S]
         [--window N] [--once] [--slo spec.json]
+    python -m paddle_tpu.monitor watch rep0.jsonl rep1.jsonl ...
+        # serving fleet: one log per replica, dashboard over the union
 
 The summary covers BOTH workloads a log may carry: training `step`
 rows (step count, latency percentiles, compile/recompile causes, MFU,
@@ -172,7 +174,10 @@ def _watch_main(argv):
         prog="python -m paddle_tpu.monitor watch",
         description="Tail a flight-recorder log and render a live "
                     "terminal dashboard")
-    p.add_argument("log", help="flight-recorder .jsonl path")
+    p.add_argument("log", nargs="+",
+                   help="flight-recorder .jsonl path(s) — one per "
+                        "replica for a serving fleet; the dashboard "
+                        "aggregates the union")
     p.add_argument("--interval", type=float, default=2.0,
                    help="seconds between refreshes (default 2)")
     p.add_argument("--window", type=int, default=256,

@@ -7,6 +7,9 @@ stall / NaN / truncation indicators and an optional live SLO verdict.
     python -m paddle_tpu.monitor watch run.jsonl
     python -m paddle_tpu.monitor watch run.jsonl --slo slo.json
     python -m paddle_tpu.monitor watch run.jsonl --once   # one frame
+    python -m paddle_tpu.monitor watch rep0.jsonl rep1.jsonl ...
+                       # serving fleet: one log per replica, the
+                       # dashboard (and --slo verdict) covers the union
 
 The tail is incremental (only new bytes are parsed per refresh) and
 tolerant: a torn trailing line — the writer is LIVE — is retried on
@@ -77,14 +80,23 @@ class WatchState:
         self.last_ts = None
 
     def feed_line(self, line):
+        e = self.parse_line(line)
+        if e is not None:
+            self.feed_event(e)
+
+    def parse_line(self, line):
+        """One JSONL line -> event dict, or None (counted skipped)."""
         try:
             e = json.loads(line)
         except json.JSONDecodeError:
             self.skipped += 1
-            return
+            return None
         if not isinstance(e, dict) or "ev" not in e:
             self.skipped += 1
-            return
+            return None
+        return e
+
+    def feed_event(self, e):
         self.events += 1
         if e.get("ts") is not None:
             self.last_ts = e["ts"]
@@ -211,12 +223,16 @@ def render_frame(state, path, slo_verdict=None, now=None):
 
 def watch(path, interval=2.0, window=256, once=False, out=None,
           slo_spec=None, max_frames=None):
-    """Tail ``path`` and render the dashboard every ``interval``
-    seconds until interrupted. ``once`` reads what is there now,
-    renders ONE frame without clearing the screen, and returns it
-    (tests and scripts). ``slo_spec`` (path/dict) adds a live verdict
-    line evaluated over the rolling request window. ``max_frames``
-    bounds the live loop (None = until Ctrl-C)."""
+    """Tail ``path`` — one flight-recorder log, or a LIST of them (a
+    serving fleet writes one per replica; the dashboard and the live
+    SLO verdict aggregate the union) — and render the dashboard every
+    ``interval`` seconds until interrupted. ``once`` reads what is
+    there now, renders ONE frame without clearing the screen, and
+    returns it (tests and scripts). ``slo_spec`` (path/dict) adds a
+    live verdict line evaluated over the rolling request window.
+    ``max_frames`` bounds the live loop (None = until Ctrl-C)."""
+    paths = [path] if isinstance(path, str) else list(path)
+    label = ", ".join(paths)
     if out is None:
         out = sys.stdout
     spec = None
@@ -224,27 +240,42 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
         from .. import slo as _slo
         spec = _slo.load_spec(slo_spec)
     state = WatchState(window=window)
-    tail = _Tail(path)
+    tails = [_Tail(p) for p in paths]
     frames = 0
     try:
         while True:
-            lines = tail.poll()
-            if lines is None:           # log not created yet
+            polls = [t.poll() for t in tails]
+            if all(p is None for p in polls):   # no log created yet
                 if once:
-                    out.write("watch: %s does not exist (yet)\n" % path)
+                    out.write("watch: %s does not exist (yet)\n"
+                              % label)
                     return None
                 out.write("\x1b[2J\x1b[Hwatch: waiting for %s ...\n"
-                          % path)
+                          % label)
                 out.flush()
                 time.sleep(interval)
                 continue
-            for line in lines:
-                state.feed_line(line)
+            # merge this poll round's rows ACROSS logs by timestamp
+            # before feeding the rolling window: fed file-by-file, the
+            # last log's rows would evict every other replica's from
+            # the window — exactly the single-replica view a fleet
+            # dashboard exists to avoid. Stable sort keeps each file's
+            # own order for ts-less rows.
+            events = []
+            for lines in polls:
+                for line in lines or ():
+                    e = state.parse_line(line)
+                    if e is not None:
+                        events.append(e)
+            events.sort(key=lambda e: (e.get("ts") is None,
+                                       e.get("ts") or 0.0))
+            for e in events:
+                state.feed_event(e)
             verdict = None
             if spec is not None:
                 from .. import slo as _slo
                 verdict = _slo.evaluate(spec, state.request_samples())
-            frame = render_frame(state, path, slo_verdict=verdict,
+            frame = render_frame(state, label, slo_verdict=verdict,
                                  now=None if once else time.time())
             if once:
                 out.write(frame + "\n")
@@ -258,4 +289,5 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
     except KeyboardInterrupt:
         return None
     finally:
-        tail.close()
+        for t in tails:
+            t.close()

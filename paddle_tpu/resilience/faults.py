@@ -21,7 +21,10 @@ Spec keys (all optional)::
         "ports": [40123],         # restrict to these server ports
         "max": 25                 # total injection budget
       },
-      "kill": [{"target": "pserver", "after": 6}],   # or "master"
+      "kill": [{"target": "pserver", "after": 6}],   # "master",
+                                        # "replica" / "replica:<slot>"
+      "stall": [{"target": "replica:1", "after": 4, "seconds": 3.0}],
+                                        # one-shot dispatch wedge
       "ckpt": {"nth": 3, "mode": "bitflip"},         # or "truncate"
       "nan":  {"step": 9, "name": "img"}             # one-shot NaN batch
     }
@@ -60,6 +63,7 @@ _DEFAULT_OPS = frozenset({
     "SEND", "PUT", "GET", "PRFT", "BARR", "CHNK",        # pserver
     "GETT", "DONE", "FAIL", "PING",                      # master
     "CAS", "DEL", "CAD", "LIST", "LEAS",                 # kv store
+    "SUBM", "POLL", "CANC", "STAT",                      # serving fleet
 })
 
 _SEND_KINDS = ("drop", "close_mid_frame", "duplicate", "delay")
@@ -92,6 +96,7 @@ class FaultPlan:
                            if ports else None)
         self._rpc_budget = int(rpc.get("max", 1 << 30))
         self._kills = [dict(k) for k in (self.spec.get("kill") or ())]
+        self._stalls = [dict(k) for k in (self.spec.get("stall") or ())]
         self._ckpt = dict(self.spec.get("ckpt") or {})
         self._ckpt_count = 0
         self._nan = dict(self.spec.get("nan") or {})
@@ -204,6 +209,26 @@ class FaultPlan:
                 return False
         _mon.on_fault("kill", target)
         return True
+
+    def should_stall(self, target, value):
+        """One-shot wedge: returns the stall duration in seconds exactly
+        once, when ``value`` reaches the plan's ``after`` threshold for
+        this target; 0.0 otherwise. Models a live-but-unresponsive
+        process (GC pause, runaway compile, wedged device): the lease
+        keeps beating, so only a response-deadline watchdog — not lease
+        expiry — can evict the member."""
+        with self._lock:
+            for k in self._stalls:
+                if k.get("target") == target and not k.get("_fired") \
+                        and value >= int(k.get("after", 0)):
+                    k["_fired"] = True
+                    self.trips.append(("stall", target))
+                    secs = float(k.get("seconds", 1.0))
+                    break
+            else:
+                return 0.0
+        _mon.on_fault("stall", target)
+        return secs
 
     # -- checkpoint corruption --------------------------------------------
     def maybe_corrupt_checkpoint(self, blob_path):

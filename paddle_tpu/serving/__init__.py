@@ -22,6 +22,19 @@ or the synchronous convenience ``eng.generate_many(prompts, 32)``.
 ``sequential_generate`` is the one-at-a-time baseline the engine is
 benchmarked (and token-identity-tested) against.
 
+Serving fleet (ISSUE 8): ``serving.fleet`` puts a self-healing front
+door ahead of N Engine replicas — each replica hosts the engine behind
+SUBM/POLL/CANC/STAT verbs on the ``distributed/rpc.py`` frame protocol
+and registers under a TTL lease; the ``Router`` dispatches
+least-loaded with session affinity, applies backpressure (bounded
+per-replica in-flight window) and load shedding (typed ``Overloaded``
+fast-fail at the global queue bound), and guarantees EXACTLY-ONCE
+completion under churn: journaled requests are re-submitted to a
+survivor on replica lease expiry or stall eviction, deduped by durable
+id, token-identical on re-execution (greedy decode). A ``Supervisor``
+respawns dead/evicted replicas. Chaos-gated by tests/test_fleet.py the
+way test_chaos.py gates training resilience.
+
 Request-level observability (ISSUE 6): every ``Request`` handle
 carries its lifecycle attribution after retirement — ``queue_wait``,
 ``ttft``, ``tpot``, ``prefill_chunks``, ``latency()`` — mirrored into
@@ -34,5 +47,9 @@ renders them live.
 
 from .engine import (Engine, Request,  # noqa: F401
                      sequential_generate)
+from .fleet import (Overloaded, Replica, ReplicaClient,  # noqa: F401
+                    ReplicaServer, Router, Supervisor)
 
-__all__ = ["Engine", "Request", "sequential_generate"]
+__all__ = ["Engine", "Request", "sequential_generate", "Router",
+           "Replica", "ReplicaServer", "ReplicaClient", "Supervisor",
+           "Overloaded"]

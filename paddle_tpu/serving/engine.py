@@ -72,11 +72,16 @@ class Request:
 
     __slots__ = ("prompt", "max_new", "tokens", "score", "_event",
                  "_error", "t_enqueue", "t_admit", "t_first_token",
-                 "t_retire", "prefill_chunks", "_span")
+                 "t_retire", "prefill_chunks", "_span", "rid")
 
-    def __init__(self, prompt, max_new):
+    def __init__(self, prompt, max_new, request_id=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
+        # durable caller-assigned id (serving.fleet router): a request
+        # RE-EXECUTED on a second replica after churn carries the SAME
+        # id, so its serving.request spans on both replicas share the
+        # rid attr — the resubmission hop is joinable in `trace merge`
+        self.rid = request_id
         self.tokens = []
         self.score = None
         self._event = threading.Event()
@@ -86,9 +91,11 @@ class Request:
         self.t_first_token = None
         self.t_retire = None
         self.prefill_chunks = 0
-        self._span = _trc.detached_span(
-            "serving.request", prompt_len=len(self.prompt),
-            max_new=self.max_new)
+        attrs = {"prompt_len": len(self.prompt),
+                 "max_new": self.max_new}
+        if request_id is not None:
+            attrs["rid"] = str(request_id)
+        self._span = _trc.detached_span("serving.request", **attrs)
         self._span.start()
 
     @property
@@ -213,6 +220,12 @@ class Engine:
                       "admissions": 0, "retirements": 0,
                       "active_slot_steps": 0, "prefill_chunks": 0,
                       "megastep_dispatches": 0}
+        # optional completion hook (serving.fleet's ReplicaServer):
+        # called with each Request AFTER its future resolves — retired
+        # or failed — so an RPC front can deliver results event-driven
+        # instead of polling handles. Exceptions are swallowed: a
+        # delivery hook must never kill the decode loop.
+        self.on_retire = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="ptpu-" + name)
         self._thread.start()
@@ -250,10 +263,14 @@ class Engine:
             self._state = state
         return self
 
-    def submit(self, prompt, max_new_tokens):
+    def submit(self, prompt, max_new_tokens, request_id=None):
         """Enqueue one request; returns its Request handle. ``prompt``
         is the token-id prefix (≥ 1 token — pass ``[model.bos_id]`` for
-        unconditional generation)."""
+        unconditional generation). ``request_id``: optional durable id
+        (the fleet router's exactly-once key) stamped on the handle and
+        its trace span — admission itself never dedups; the fleet tier
+        (ReplicaServer journal) is where resubmitted ids are made
+        idempotent BEFORE they reach the engine."""
         prompt = [int(t) for t in (prompt or [self.model.bos_id])]
         max_new = int(max_new_tokens)
         if max_new < 1:
@@ -275,7 +292,7 @@ class Engine:
                 raise RuntimeError("engine is closed")
             # construct after the closed-check: a rejected submit must
             # not open a request span nobody will ever finish
-            req = Request(prompt, max_new)
+            req = Request(prompt, max_new, request_id=request_id)
             self._queue.append(req)
             self._cv.notify_all()
         return req
@@ -471,6 +488,13 @@ class Engine:
             # resolve here or result() blocks forever.
             for req, score in finished:
                 req._finish(score)
+            cb = self.on_retire
+            if cb is not None:
+                for req, _ in finished:
+                    try:
+                        cb(req)
+                    except Exception:
+                        pass
 
     def _retire_telemetry(self, req, error=None):
         """Per-request attribution at retirement: TTFT/TPOT/queue_wait
@@ -658,6 +682,7 @@ class Engine:
             pending += list(self._queue)
             self._queue.clear()
             self._recs = [None] * self.slots
+        cb = self.on_retire
         for req in pending:
             # failed requests still retire for attribution purposes:
             # their row/span carries the error, and the SLO error
@@ -666,6 +691,11 @@ class Engine:
                 req.t_retire = time.perf_counter()
             self._retire_telemetry(req, error=err)
             req._fail(err)
+            if cb is not None:
+                try:
+                    cb(req)
+                except Exception:
+                    pass
 
 
 # -- sequential baseline ---------------------------------------------------

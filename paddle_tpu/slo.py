@@ -8,8 +8,9 @@ engine step latency and an error budget, and this module evaluates the
 spec against any of the three observability surfaces the runtime
 already produces:
 
-  * a monitor flight-recorder JSONL (``serving_request`` /
-    ``serving_step`` rows — EXACT per-request samples),
+  * monitor flight-recorder JSONL(s) (``serving_request`` /
+    ``serving_step`` rows — EXACT per-request samples; pass one log
+    per replica and the verdict covers the fleet-wide union),
   * trace span logs (``serving.request`` spans whose close-time attrs
     carry the same figures — the merged-fleet-timeline source: pass
     every process's span log and the verdict covers the fleet),
@@ -34,6 +35,8 @@ An objective with NO samples fails (a run that measured nothing cannot
 claim an SLO was met) and says so in its reason. CLI::
 
     python -m paddle_tpu.slo spec.json --log run.jsonl [--json]
+    python -m paddle_tpu.slo spec.json --log rep0.jsonl rep1.jsonl ...
+                                  # fleet: union across replica logs
     python -m paddle_tpu.slo spec.json --spans *.jsonl
     python -m paddle_tpu.slo spec.json --metrics metrics.json
 
@@ -43,6 +46,7 @@ gate contract), 2 = usage or spec error.
 
 import argparse
 import json
+import os
 import sys
 
 from .monitor.metrics import bucket_percentile as _hist_percentile
@@ -141,11 +145,22 @@ def samples_from_events(events, source="events"):
     return out
 
 
-def samples_from_monitor_log(path):
+def samples_from_monitor_log(paths):
     """Exact per-request samples from ``serving_request`` rows (+
-    ``serving_step`` dt for step_latency) of one flight-recorder log."""
-    events, skipped = read_jsonl_tolerant(path)
-    out = samples_from_events(events, "monitor log %s" % path)
+    ``serving_step`` dt for step_latency) of one flight-recorder log —
+    or the UNION of several (one log per replica of a serving fleet:
+    fleet-wide percentiles come from every process's rows, not a
+    single replica's view). ``paths``: one path or a sequence."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    events, skipped = [], 0
+    for path in paths:
+        evs, sk = read_jsonl_tolerant(path)
+        events.extend(evs)
+        skipped += sk
+    out = samples_from_events(
+        events, "monitor log%s %s" % ("s" if len(paths) > 1 else "",
+                                      ", ".join(map(str, paths))))
     out["skipped"] = skipped
     return out
 
@@ -317,7 +332,10 @@ def main(argv=None):
     p.add_argument("spec", nargs="?", default=None,
                    help="SLO spec JSON path (default: the "
                         "PADDLE_TPU_SLO_SPEC flag)")
-    p.add_argument("--log", help="monitor flight-recorder .jsonl")
+    p.add_argument("--log", nargs="+",
+                   help="monitor flight-recorder .jsonl file(s) — "
+                        "pass one per replica and the verdict covers "
+                        "the fleet-wide union")
     p.add_argument("--spans", nargs="+",
                    help="trace span-log .jsonl file(s) — the merged "
                         "fleet-timeline surface")

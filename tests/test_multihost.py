@@ -22,6 +22,88 @@ import sys
 import numpy as np
 import pytest
 
+# -- capability probe -------------------------------------------------------
+# Every test here spawns a two-process jax.distributed pair on the CPU
+# backend (the workers pin JAX_PLATFORMS=cpu). Some jaxlib CPU backends
+# (0.4.37 among them) refuse cross-process computations outright:
+# "Multiprocess computations aren't implemented on the CPU backend" —
+# a toolchain limitation, not a product bug (ROADMAP "known issues").
+# Probe it EXPLICITLY once per module run with a minimal two-process
+# reduction (a couple of seconds — far cheaper than four full
+# model-training pairs failing) and skip the module on the limitation;
+# chip containers with a capable jaxlib keep the tests live. The probe
+# runs lazily (module-scoped autouse fixture), so collection and runs
+# that deselect this module pay nothing.
+
+_PROBE_WORKER = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.distributed.initialize(
+    coordinator_address=os.environ["PROBE_COORD"],
+    num_processes=2, process_id=int(os.environ["PROBE_RANK"]))
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(jax.devices(), ("dp",))
+arr = jax.make_array_from_callback(
+    (4,), NamedSharding(mesh, P("dp")), lambda idx: jnp.ones((1,)))
+out = jax.jit(lambda x: x.sum(),
+              out_shardings=NamedSharding(mesh, P()))(arr)
+jax.block_until_ready(out)
+print("MULTIHOST_PROBE_OK", flush=True)
+"""
+
+_CPU_MULTIPROCESS_LIMITATION = \
+    "Multiprocess computations aren't implemented"
+
+
+def _cpu_multiprocess_unsupported():
+    """(skip?, reason): run the minimal cross-process CPU collective
+    once; skip only on the KNOWN backend limitation — any other probe
+    failure keeps the tests live so real regressions stay visible."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        script = os.path.join(d, "probe.py")
+        with open(script, "w") as f:
+            f.write(_PROBE_WORKER)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        procs = []
+        for r in range(2):
+            env = dict(os.environ)
+            env.update({"PROBE_COORD": "127.0.0.1:%d" % port,
+                        "PROBE_RANK": str(r)})
+            procs.append(subprocess.Popen(
+                [sys.executable, script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=90)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                return False, "probe timeout (not the known limitation)"
+            outs.append(out)
+    if all("MULTIHOST_PROBE_OK" in o for o in outs):
+        return False, "cpu backend supports multiprocess"
+    if any(_CPU_MULTIPROCESS_LIMITATION in o for o in outs):
+        return True, ("jaxlib CPU backend limitation: %s"
+                      % _CPU_MULTIPROCESS_LIMITATION)
+    return False, "probe failed for an unexpected reason"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _require_cpu_multiprocess():
+    skip, reason = _cpu_multiprocess_unsupported()
+    if skip:
+        pytest.skip(reason)
+
+
 _WORKER = r"""
 import json, os, sys
 sys.path.insert(0, %(repo)r)

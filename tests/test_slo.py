@@ -193,6 +193,74 @@ def test_metrics_snapshot_source(tmp_path):
     assert v2["pass"] is False
 
 
+# -- fleet: union across per-replica logs (ISSUE 8 satellite) --------------
+
+def _replica_log(path, n, ttft0, errors=0):
+    rows = []
+    for i in range(n):
+        rows.append({"ts": 1.0 + i, "ev": "serving_request",
+                     "engine": os.path.basename(str(path)),
+                     "queue_wait": 0.001, "ttft": ttft0 + 0.001 * i,
+                     "tpot": 0.002, "tokens": 8, "prefill_chunks": 1,
+                     "prompt_len": 4})
+        rows.append({"ts": 1.0 + i, "ev": "serving_step", "active": 2,
+                     "slots": 2, "queue_depth": 0, "emitted": 2,
+                     "admitted": 0, "retired": 0, "dt": 0.003})
+    for _ in range(errors):
+        rows.append({"ts": 99.0, "ev": "serving_request",
+                     "engine": os.path.basename(str(path)),
+                     "error": "Overloaded(...)", "tokens": 0})
+    with open(path, "w") as f:
+        f.write("\n".join(json.dumps(r) for r in rows) + "\n")
+
+
+def test_slo_log_union_across_replica_logs(tmp_path, capsys):
+    """Fleet-wide percentiles come from the UNION of per-replica logs,
+    not a single process's view: the p95 over both replicas' TTFT
+    samples differs from either log alone, and the error budget counts
+    every replica's failures."""
+    a, b = str(tmp_path / "rep0.jsonl"), str(tmp_path / "rep1.jsonl")
+    _replica_log(a, 10, ttft0=0.010)            # 10..19 ms
+    _replica_log(b, 10, ttft0=0.050, errors=1)  # 50..59 ms + 1 error
+    sa = slo.samples_from_monitor_log(a)
+    su = slo.samples_from_monitor_log([a, b])
+    assert sa["requests"] == 10 and su["requests"] == 21
+    assert su["errors"] == 1
+    assert len(su["ttft"]) == 20 and len(su["step_latency"]) == 20
+    spec = {"objectives": [
+        {"metric": "ttft", "percentile": 0.95, "max_seconds": 0.030},
+        {"metric": "error_rate", "max_ratio": 0.10}]}
+    # replica 0 alone passes 30ms; the union must NOT (p95 ~59ms) —
+    # a single-log verdict would flatter the fleet
+    assert slo.evaluate(spec, sa)["pass"] is True
+    vu = slo.evaluate(spec, su)
+    assert vu["pass"] is False
+    by = {r["metric"]: r for r in vu["objectives"]}
+    assert by["ttft"]["measured"] == pytest.approx(0.058)
+    assert by["error_rate"]["measured"] == pytest.approx(1 / 21)
+    # the CLI takes several --log paths
+    s = json.dumps(spec)
+    assert slo.main([s, "--log", a]) == 0
+    capsys.readouterr()
+    assert slo.main([s, "--log", a, b]) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+
+def test_watch_once_over_multiple_replica_logs(tmp_path):
+    from paddle_tpu.monitor.watch import watch
+    a, b = str(tmp_path / "rep0.jsonl"), str(tmp_path / "rep1.jsonl")
+    _replica_log(a, 5, ttft0=0.010)
+    _replica_log(b, 7, ttft0=0.020, errors=1)
+    buf = io.StringIO()
+    frame = watch([a, b], once=True, out=buf)
+    assert frame is not None
+    assert "n 13" in frame          # 5 + 7 + 1 failed, unioned
+    assert "errors 1" in frame
+    assert "steps 12" in frame      # serving_step rows across both
+    from paddle_tpu.monitor.__main__ import main as mon_main
+    assert mon_main(["watch", a, b, "--once"]) == 0
+
+
 # -- the live dashboard -----------------------------------------------------
 
 def test_watch_renders_once_on_static_log():
