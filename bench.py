@@ -519,6 +519,96 @@ def main():
 
     fleet_summary = guarded("fleet-probe", fleet_probe, errors)
 
+    def transform_probe():
+        """ISSUE-9 transform probe, CPU-pinned like the serving probe:
+        (a) the optimizing pass pipeline over the Program zoo (rewrite
+        only — the bitwise verification gate lives in tier-1), stamping
+        per-model ops-removed; (b) interleaved A/B step-time delta of
+        the TRANSFORMED vs untransformed program on the dispatch-bound
+        train shape (megastep-probe protocol: alternating windows,
+        median + spread); (c) the autoparallel planner's top-3 ranking
+        for the transformer zoo model at 8 virtual devices."""
+        import jax
+        import numpy as np
+        from paddle_tpu import flags as _flags
+        from paddle_tpu.models import (TRANSFORM_ZOO,
+                                       transform_zoo_entry)
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.transform import PassManager, recommend
+        prev = jax.config.jax_default_device
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        # pin the armed-transform flag OFF for the A/B: with
+        # PADDLE_TPU_TRANSFORM=1 in the environment the "untransformed"
+        # arm would silently compile the transformed clone too and the
+        # stamped delta would measure transformed-vs-transformed
+        _flags.set_flag("transform", False)
+        try:
+            removed = {}
+            for name in sorted(TRANSFORM_ZOO):
+                main, _, _, fetch_names = transform_zoo_entry(name)
+                removed[name] = PassManager().run(
+                    main, keep=fetch_names).ops_removed
+
+            _fresh()
+            avg_cost, _ = T.transformer_lm(
+                vocab_size=256, max_len=16, n_layer=2, n_head=2,
+                d_model=64, d_inner=256, packed=True)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+            main = fluid.default_main_program()
+            transformed = PassManager().run(
+                main, keep=[avg_cost.name]).program
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            rng = np.random.RandomState(0)
+            feed = T.make_lm_batch(rng, 4, 16, 256)
+            feed["mask"] = np.ones_like(feed["mask"])
+            toks = int(feed["mask"].sum())
+            steps, wins = 64, 5
+
+            def win(prog):
+                t0 = time.perf_counter()
+                last = None
+                for _ in range(steps):
+                    last = exe.run(prog, feed=feed,
+                                   fetch_list=[avg_cost.name],
+                                   return_numpy=False)
+                jax.block_until_ready(last)
+                return steps * toks / (time.perf_counter() - t0)
+
+            win(main), win(transformed)     # warm both compiles
+            a, b = [], []
+            for _ in range(wins):           # interleaved A/B
+                a.append(win(main))
+                b.append(win(transformed))
+            m0, sp0, s0 = agg(a, nd=0)
+            m1, sp1, s1 = agg(b, nd=0)
+
+            plans = recommend("transformer", 8, top=3)
+            probe = {
+                "zoo_ops_removed": removed,
+                "config": "transformer_lm 2L/d64 bs4 T16 (CPU pin)",
+                "steps_per_window": steps, "windows": wins,
+                "untransformed_tok_s": round(m0),
+                "untransformed_spread_pct": sp0,
+                "untransformed_samples": s0,
+                "transformed_tok_s": round(m1),
+                "transformed_spread_pct": sp1,
+                "transformed_samples": s1,
+                "delta_pct": round(100.0 * (m1 - m0) / m0, 1),
+                "planner_top3_transformer_8dev": [
+                    {"plan": p.describe(),
+                     "cost_s": float("%.3e" % p.cost)}
+                    for p in plans],
+            }
+            print("transform probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            _flags.set_flag("transform", None)   # back to env-driven
+            jax.config.update("jax_default_device", prev)
+
+    transform_summary = guarded("transform-probe", transform_probe,
+                                errors)
+
     ips, res_spread, res_samples = agg(res_s)
     large_flops_tok = flops_per_token(L=8, D=1024, FFN=4096, T=1024,
                                       V=8192)
@@ -576,6 +666,12 @@ def main():
         # megastep K-sweep stamp (ISSUE 7): K=1 vs K=8 interleaved
         # A/B medians on the dispatch-bound train shape
         out["megastep"] = megastep_summary
+    if transform_summary is not None:
+        # program-transform stamp (ISSUE 9): per-zoo-model ops removed
+        # by the pass pipeline, transformed-vs-untransformed interleaved
+        # A/B on the dispatch-bound train shape, and the autoparallel
+        # planner's top-3 for the transformer zoo model at 8 devices
+        out["transform"] = transform_summary
     if fleet_summary is not None:
         # serving-fleet stamp (ISSUE 8): disarmed router overhead
         # (interleaved A/B vs direct engine, per-request p50/p95 added

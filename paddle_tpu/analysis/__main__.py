@@ -26,6 +26,9 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.reader",
     "paddle_tpu.reader.device_loader",
     "paddle_tpu.slo",
+    "paddle_tpu.transform",
+    "paddle_tpu.transform.passes",
+    "paddle_tpu.transform.autoparallel",
     "paddle_tpu.trace",
     "paddle_tpu.trace.runtime",
     "paddle_tpu.trace.clock",

@@ -1105,6 +1105,15 @@ class Executor:
         the host-side [k, ...] pre-split (_normalize_feeds). Only
         targets and persistables are fetchable (microbatch intermediates
         never leave the scan)."""
+        # armed program transform (PADDLE_TPU_TRANSFORM=1): the pass
+        # pipeline rewrites a CLONE and the trace below builds from it,
+        # while the compile-cache key stays the caller's program +
+        # version — a cache hit never re-transforms, and a transformed
+        # program recompile is classified by the monitor via the
+        # clone's _transform_meta (new program_version), not
+        # mystery-counted. Disarmed cost: one flag check.
+        from ..transform.passes import maybe_transform_for_build
+        program = maybe_transform_for_build(program, fetch_names)
         static_info = static_info or {}
         block = program.global_block()
         ops = list(block.ops)

@@ -29,6 +29,9 @@ Registered flags:
                         verbs (bounded backoff + total deadline)
   feed_plan_cache bool  cache _normalize_feeds plans + committed device
                         feed buffers across same-signature run() calls
+  transform*      —     paddle_tpu.transform optimizing IR passes (arm
+                        at the compile path, pass selection) + the
+                        autoparallel planner's default device count
   serving*        —     paddle_tpu.serving continuous-batching engine
                         knobs (prefill chunk length, admission window,
                         fused decode megastep K) and serving.fleet
@@ -218,6 +221,22 @@ _register("slo_spec", str, "",
           "it when no spec argument is given, and python -m "
           "paddle_tpu.monitor watch renders a live verdict line "
           "against it (see paddle_tpu/slo.py for the spec schema)")
+_register("transform", bool, False,
+          "arm paddle_tpu.transform at the executors' compile path: "
+          "every compile-cache MISS runs the optimizing pass pipeline "
+          "(see transform_passes) over the program and builds the "
+          "transformed clone — the cache key stays the caller's "
+          "program+version, and passes are semantics-preserving "
+          "(bitwise-identical fetches, pinned in tests/test_transform)")
+_register("transform_passes", str, "all",
+          "which optimizing passes the armed transform (and the "
+          "python -m paddle_tpu.transform CLI default) runs: 'all', "
+          "'none', or a comma list from {constant_fold, cse, dead_op} "
+          "in application order")
+_register("autoparallel_devices", int, 0,
+          "default device count for the automatic parallelism planner "
+          "(python -m paddle_tpu.transform --plan / "
+          "transform.recommend); 0 = jax.device_count() at call time")
 _register("fuse_conv_bn", bool, False,
           "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
           "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "

@@ -39,3 +39,44 @@ def zoo_entry(name):
         raise KeyError("unknown zoo model %r (have: %s)"
                        % (name, ", ".join(sorted(ZOO))))
     return getattr(importlib.import_module(mod_name), attr)()
+
+
+# Program-level zoo (paddle_tpu.transform): every workload whose train
+# step is a real Program the pass pipeline can rewrite and the bitwise
+# verifier can re-execute. Entries name each module's zoo_spec*
+# (build_fn, feed_fn) factory — the same source the analysis entries
+# trace — and transform_zoo_entry stages the Programs centrally.
+# transformer_infer / serving_megastep are jax-function entries (they
+# trace Engine internals, no Program), so they are excluded by
+# construction.
+TRANSFORM_ZOO = {
+    "mlp": ("paddle_tpu.models.mlp", "zoo_spec"),
+    "cnn": ("paddle_tpu.models.mlp", "zoo_spec_cnn"),
+    "resnet": ("paddle_tpu.models.resnet", "zoo_spec"),
+    "vgg": ("paddle_tpu.models.vgg", "zoo_spec"),
+    "ssd": ("paddle_tpu.models.ssd", "zoo_spec"),
+    "deepfm": ("paddle_tpu.models.deepfm", "zoo_spec"),
+    "transformer": ("paddle_tpu.models.transformer", "zoo_spec"),
+    "transformer_moe": ("paddle_tpu.models.transformer",
+                        "zoo_spec_moe"),
+    # encoder-decoder MT parity model — Program-zoo only (its traced
+    # twin would duplicate the LM's analysis coverage); its build
+    # derives two attention biases from src_mask through identical
+    # chains, the zoo's measured CSE redundancy
+    "transformer_mt": ("paddle_tpu.models.transformer",
+                       "zoo_spec_mt"),
+}
+
+
+def transform_zoo_entry(name):
+    """Resolve a Program-level zoo entry and stage its programs:
+    returns (main, startup, feed_fn, fetch_names)."""
+    from .harness import staged_programs
+    try:
+        mod_name, attr = TRANSFORM_ZOO[name]
+    except KeyError:
+        raise KeyError(
+            "unknown transform-zoo model %r (have: %s)"
+            % (name, ", ".join(sorted(TRANSFORM_ZOO))))
+    spec = getattr(importlib.import_module(mod_name), attr)()
+    return staged_programs(*spec)

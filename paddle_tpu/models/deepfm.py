@@ -70,11 +70,9 @@ def build_train_net(num_fields=8, vocab_size=1000, embed_dim=8,
     return fields, label, prob, loss
 
 
-def analysis_entry():
-    """Static-analyzer entry: DeepFM CTR Adam train step (sparse
-    embedding lookups + FM interactions)."""
+def zoo_spec():
+    """(build_fn, feed_fn): DeepFM CTR Adam train step."""
     import numpy as np
-    from .harness import program_entry
     num_fields, vocab = 8, 1000
 
     def build():
@@ -88,4 +86,12 @@ def analysis_entry():
         f["click"] = rng.randint(0, 2, (8, 1)).astype(np.float32)
         return f
 
-    return program_entry(build, feeds)
+    return build, feeds
+
+
+def analysis_entry():
+    """Static-analyzer entry: DeepFM CTR Adam train step (sparse
+    embedding lookups + FM interactions)."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec())
+

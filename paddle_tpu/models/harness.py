@@ -24,6 +24,23 @@ armed fault plan (the chaos tests do exactly this with the MLP).
 import numpy as np
 
 
+def staged_programs(build_fn, feed_fn):
+    """(main, startup, feed_fn, fetch_names) with the programs freshly
+    built under their own guards — the Program-level zoo surface
+    ``paddle_tpu.transform`` rewrites and verifies. Build only: nothing
+    compiles or executes here (the transform verifier runs startup
+    itself so both the original and the transformed program start from
+    one identical initialized state)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch_vars = build_fn()
+        if not isinstance(fetch_vars, (tuple, list)):
+            fetch_vars = (fetch_vars,)
+    return main, startup, feed_fn, [v.name for v in fetch_vars]
+
+
 def program_entry(build_fn, feed_fn, seed=0):
     """(fn, example_args) for the analyzer.
 

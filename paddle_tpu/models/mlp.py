@@ -30,10 +30,9 @@ def cnn(img, label, num_classes=10):
     return prediction, avg_cost, acc
 
 
-def analysis_entry():
-    """Static-analyzer entry: MLP Adam train step (see models/harness)."""
-    from .harness import program_entry
-
+def zoo_spec():
+    """(build_fn, feed_fn) for the MLP Adam train step — one source
+    for the analysis (traced) and transform (Program-level) zoo."""
     def build():
         img = fluid.layers.data("img", [784])
         label = fluid.layers.data("label", [1], dtype="int64")
@@ -45,13 +44,11 @@ def analysis_entry():
         return {"img": rng.rand(8, 784).astype("float32"),
                 "label": rng.randint(0, 10, (8, 1)).astype("int64")}
 
-    return program_entry(build, feeds)
+    return build, feeds
 
 
-def analysis_entry_cnn():
-    """Static-analyzer entry: LeNet CNN Adam train step."""
-    from .harness import program_entry
-
+def zoo_spec_cnn():
+    """(build_fn, feed_fn) for the LeNet CNN Adam train step."""
     def build():
         img = fluid.layers.data("img", [1, 28, 28])
         label = fluid.layers.data("label", [1], dtype="int64")
@@ -63,4 +60,17 @@ def analysis_entry_cnn():
         return {"img": rng.rand(4, 1, 28, 28).astype("float32"),
                 "label": rng.randint(0, 10, (4, 1)).astype("int64")}
 
-    return program_entry(build, feeds)
+    return build, feeds
+
+
+def analysis_entry():
+    """Static-analyzer entry: MLP Adam train step (see models/harness)."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec())
+
+
+def analysis_entry_cnn():
+    """Static-analyzer entry: LeNet CNN Adam train step."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec_cnn())
+

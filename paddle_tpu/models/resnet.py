@@ -100,10 +100,8 @@ def build_train_net(model="resnet_cifar10", depth=None, image_shape=(3, 32, 32),
     return image, label, avg_cost, acc
 
 
-def analysis_entry():
-    """Static-analyzer entry: ResNet-CIFAR10 Momentum train step."""
-    from .harness import program_entry
-
+def zoo_spec():
+    """(build_fn, feed_fn): ResNet-CIFAR10 Momentum train step."""
     def build():
         _, _, avg_cost, acc = build_train_net(
             model="resnet_cifar10", depth=8, image_shape=(3, 16, 16))
@@ -113,4 +111,11 @@ def analysis_entry():
         return {"data": rng.rand(4, 3, 16, 16).astype("float32"),
                 "label": rng.randint(0, 10, (4, 1)).astype("int64")}
 
-    return program_entry(build, feeds)
+    return build, feeds
+
+
+def analysis_entry():
+    """Static-analyzer entry: ResNet-CIFAR10 Momentum train step."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec())
+

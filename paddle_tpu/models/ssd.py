@@ -67,12 +67,10 @@ def build_ssd_infer_net(image_shape=(3, 64, 64), num_classes=5,
     return image, dets
 
 
-def analysis_entry():
-    """Static-analyzer entry: SSD train step with LoD ground truth (the
-    analyzer sees the bucketed flat-LoD feed layout)."""
+def zoo_spec():
+    """(build_fn, feed_fn): SSD train step with LoD ground truth."""
     import numpy as np
     from paddle_tpu.core.lod import create_lod_tensor
-    from .harness import program_entry
 
     def build():
         _, _, _, loss = build_ssd_train_net(image_shape=(3, 64, 64))
@@ -86,4 +84,12 @@ def analysis_entry():
                 "gt_box": create_lod_tensor(gt, [[2, 1]]),
                 "gt_label": create_lod_tensor(lab, [[2, 1]])}
 
-    return program_entry(build, feeds)
+    return build, feeds
+
+
+def analysis_entry():
+    """Static-analyzer entry: SSD train step with LoD ground truth (the
+    analyzer sees the bucketed flat-LoD feed layout)."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec())
+

@@ -408,10 +408,9 @@ def _parallel_decoder_layer(x, n_head, d_key, d_value, d_model, d_inner,
                              begin_norm_axis=len(x.shape) - 1)
 
 
-def analysis_entry():
-    """Static-analyzer entry: flagship decoder-only LM, SGD train step
+def zoo_spec():
+    """(build_fn, feed_fn): flagship decoder-only LM, SGD train step
     (the same tiny config the driver's entry() compiles)."""
-    from .harness import program_entry
     vocab, max_len = 256, 32
 
     def build():
@@ -424,13 +423,12 @@ def analysis_entry():
     def feeds(rng):
         return make_lm_batch(rng, 4, max_len, vocab)
 
-    return program_entry(build, feeds)
+    return build, feeds
 
 
-def analysis_entry_moe():
-    """Static-analyzer entry: MoE LM (sparse_moe FFN, dense fallback
-    routing on one device) — keeps the expert path lint-covered."""
-    from .harness import program_entry
+def zoo_spec_moe():
+    """(build_fn, feed_fn): MoE LM (sparse_moe FFN, dense fallback
+    routing on one device)."""
     vocab, max_len = 256, 32
 
     def build():
@@ -442,7 +440,77 @@ def analysis_entry_moe():
     def feeds(rng):
         return make_lm_batch(rng, 4, max_len, vocab)
 
-    return program_entry(build, feeds)
+    return build, feeds
+
+
+def zoo_spec_mt():
+    """(build_fn, feed_fn): encoder-decoder MT model
+    (machine_translation benchmark parity), SGD train step. The build
+    derives BOTH the encoder self-attention bias and the decoder
+    cross-attention bias from ``src_mask`` through identical
+    make_attn_bias chains — the redundancy the transform tier's CSE
+    pass is measured against (tests pin that this program shrinks)."""
+    vocab, max_len = 64, 16
+
+    def build():
+        avg_cost, _ = transformer(
+            src_vocab_size=vocab, trg_vocab_size=vocab,
+            max_len=max_len, n_layer=1, n_head=2, d_model=32,
+            d_inner=64)
+        fluid.optimizer.SGD(learning_rate=1e-3).minimize(avg_cost)
+        return (avg_cost,)
+
+    def feeds(rng):
+        src = make_lm_batch(rng, 2, max_len, vocab)
+        trg = make_lm_batch(rng, 2, max_len, vocab)
+        return {"src_word": src["src"], "src_pos": src["pos"],
+                "src_mask": src["mask"], "trg_word": trg["src"],
+                "trg_pos": trg["pos"], "trg_mask": trg["mask"],
+                "lbl_word": trg["label"]}
+
+    return build, feeds
+
+
+def analysis_entry():
+    """Static-analyzer entry: flagship decoder-only LM, SGD train step."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec())
+
+
+def analysis_entry_moe():
+    """Static-analyzer entry: MoE LM — keeps the expert path
+    lint-covered."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec_moe())
+
+
+
+def plan_entry():
+    """Automatic-parallelism planner surface (transform/autoparallel):
+    the tiny flagship LM with a STRATEGY-AWARE builder plus the
+    structural facts the comm/bubble cost model sizes its terms from.
+    ``build(strategy)`` routes through transformer_lm_parallel, so the
+    planner's apply() instantiates the exact pp/tp/sp/ep composition
+    the parity tests already pin against single-device math; build()
+    with no strategy is the single-device pricing baseline."""
+    vocab, max_len, n_layer, n_head = 256, 32, 2, 4
+    d_model, d_inner, batch = 64, 128, 8
+
+    def build(strategy=None):
+        avg_cost, _ = transformer_lm_parallel(
+            vocab_size=vocab, max_len=max_len, n_layer=n_layer,
+            n_head=n_head, d_model=d_model, d_inner=d_inner,
+            strategy=strategy)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+        return (avg_cost,)
+
+    def feeds(rng):
+        return make_lm_batch(rng, batch, max_len, vocab)
+
+    return {"build": build, "feeds": feeds, "batch": batch,
+            "seq": max_len, "d_model": d_model, "n_layer": n_layer,
+            "n_head": n_head, "d_inner": d_inner, "vocab": vocab,
+            "num_experts": 0}
 
 
 def make_lm_batch(rng, batch, max_len, vocab_size):

@@ -38,11 +38,10 @@ def build_train_net(image_shape=(3, 32, 32), num_classes=10,
     return image, label, avg_cost, acc
 
 
-def analysis_entry():
-    """Static-analyzer entry: VGG-16 Adam train step (with dropout, so
-    the traced step exercises the RNG path)."""
-    from .harness import program_entry
-
+def zoo_spec():
+    """(build_fn, feed_fn): VGG-16 Adam train step (with dropout, so
+    the step exercises the RNG path — transform passes must pin the
+    dropout ops in place to keep the stream bitwise-stable)."""
     def build():
         _, _, avg_cost, acc = build_train_net(image_shape=(3, 32, 32))
         return avg_cost, acc
@@ -51,4 +50,11 @@ def analysis_entry():
         return {"data": rng.rand(2, 3, 32, 32).astype("float32"),
                 "label": rng.randint(0, 10, (2, 1)).astype("int64")}
 
-    return program_entry(build, feeds)
+    return build, feeds
+
+
+def analysis_entry():
+    """Static-analyzer entry: VGG-16 Adam train step."""
+    from .harness import program_entry
+    return program_entry(*zoo_spec())
+

@@ -32,7 +32,7 @@ from .runtime import (  # noqa: F401
     set_tokens_per_step, on_compile, on_cache_hit, on_step, on_nan_trip,
     on_retry, on_reconnect, on_fault, on_rollback, on_resume,
     on_checkpoint, on_serving_step, on_serving_request, on_feed_plan,
-    on_megastep, feed_nbytes,
+    on_megastep, on_transform, feed_nbytes,
     tokens_in_feeds, sync_every, step_timer, summary, session,
     prometheus_text, dump_metrics, maybe_enable_from_flags,
     reset_for_tests,

@@ -36,8 +36,8 @@ __all__ = [
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
     "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
     "on_checkpoint", "on_serving_step", "on_serving_request",
-    "on_feed_plan", "on_megastep", "summary", "session",
-    "prometheus_text", "dump_metrics",
+    "on_feed_plan", "on_megastep", "on_transform", "summary",
+    "session", "prometheus_text", "dump_metrics",
 ]
 
 _REG = _metrics.registry()
@@ -163,6 +163,16 @@ FEED_NORMALIZATIONS = _REG.counter(
 FEED_PLAN_HITS = _REG.counter(
     "ptpu_feed_plan_hits_total",
     "feed-plan cache hits (per-call feed normalization skipped)")
+# program-transform tier (paddle_tpu.transform): optimizing-pass
+# activity. Counters tick unconditionally (transforms run per compile,
+# not per step); the flight-recorder row lands only when armed
+TRANSFORM_PASSES = _REG.counter(
+    "ptpu_transform_passes_total",
+    "optimizing-pass rewrite phases executed over a Program",
+    ("pass",))
+TRANSFORM_OPS_REMOVED = _REG.counter(
+    "ptpu_transform_ops_removed_total",
+    "ops removed or rewritten by an optimizing pass", ("pass",))
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -395,6 +405,15 @@ def on_compile(program, key, feed_sig, cost_fn=None, executor="exe",
     rec, dog = _S.rec, _S.dog
     _maybe_record_devices()
     version = getattr(program, "_version", None)
+    # a PassManager-transformed clone announces itself (parent version
+    # + new program_version in _transform_meta); on the ARMED executor
+    # path the caller's program carries the mirrored _transform_applied
+    # (the compiled body was the transformed clone even though the
+    # cache key — and this hook — see the original). Either way the
+    # compile is attributed to the transform instead of counting as a
+    # mystery new_program, so a post-transform recompile is classified
+    transform_meta = getattr(program, "_transform_meta", None) \
+        or getattr(program, "_transform_applied", None)
     # classify under the lock: two threads compiling the same program
     # concurrently (a supported Executor pattern) must not both read
     # count==0 and report new_program, hiding a real recompile
@@ -403,7 +422,8 @@ def on_compile(program, key, feed_sig, cost_fn=None, executor="exe",
             program, {"versions": set(), "sigs": set(), "pairs": set(),
                       "count": 0})
         if ent["count"] == 0:
-            reason = "new_program"
+            reason = ("transformed_program" if transform_meta
+                      else "new_program")
         elif version not in ent["versions"]:
             reason = "program_version"
         elif feed_sig not in ent["sigs"]:
@@ -445,10 +465,13 @@ def on_compile(program, key, feed_sig, cost_fn=None, executor="exe",
     if dog is not None:
         dog.touch()
     if rec is not None:
+        extra = {}
+        if transform_meta is not None:
+            extra["transform_of"] = transform_meta.get("parent_version")
         rec.record("compile", executor=executor, reason=reason,
                    recompile=recompile, program=id(program),
                    version=version, flops=flops, bytes=nbytes,
-                   tokens=tokens)
+                   tokens=tokens, **extra)
     _sample_device_memory()
 
 
@@ -770,6 +793,32 @@ def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,
 def on_feed_plan(hit):
     """core/executor feed-plan cache outcome for one run() call."""
     (FEED_PLAN_HITS if hit else FEED_NORMALIZATIONS).inc()
+
+
+def on_transform(program, pass_name, ops_before, ops_after, dt,
+                 changes=None):
+    """One optimizing-pass rewrite phase over a Program completed
+    (paddle_tpu.transform.PassManager). ``changes`` is the pass's own
+    removed-or-rewritten count — constant folding REPLACES ops in
+    place, so the op-count delta alone would hide its work. Counters
+    tick unconditionally (transforms run per compile, not per step);
+    the armed recorder additionally lands a ``transform`` row —
+    program id, pass, ops before/after, wall time — following the
+    PR-2 row conventions."""
+    removed = int(changes) if changes is not None \
+        else max(0, int(ops_before) - int(ops_after))
+    TRANSFORM_PASSES.inc(**{"pass": pass_name})
+    if removed:
+        TRANSFORM_OPS_REMOVED.inc(removed, **{"pass": pass_name})
+    if not _S.on:
+        return
+    rec = _S.rec
+    if rec is not None:
+        rec.record("transform", program=id(program),
+                   version=getattr(program, "_version", None),
+                   **{"pass": pass_name, "ops_before": int(ops_before),
+                      "ops_after": int(ops_after), "removed": removed,
+                      "dt": dt})
 
 
 _mem_sample_counter = [0]

@@ -158,7 +158,7 @@ def _modified_huber_loss(ctx, op):
     ctx.set_out(op, "Out", loss)
 
 
-@register("nce")
+@register("nce", stateful_rng=True)   # samples negatives from the stream
 def _nce(ctx, op):
     """Noise-contrastive estimation (operators/nce_op.cc) — full-softmax-free
     training of big output layers. Samples negatives uniformly."""

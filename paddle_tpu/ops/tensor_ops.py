@@ -183,8 +183,11 @@ def _one_hot(ctx, op):
     ctx.set_out(op, "Out", jax.nn.one_hot(x.astype(jnp.int32), depth))
 
 
-@register("uniform_random")
-@register("uniform_random_batch_size_like")
+# Random ops are stateful_rng: each draw advances the trace-order PRNG
+# stream, so the transform tier must pin them in place (removing or
+# deduplicating one would shift every later op's stream position).
+@register("uniform_random", stateful_rng=True)
+@register("uniform_random_batch_size_like", stateful_rng=True)
 def _uniform_random(ctx, op):
     shape = list(op.attr("shape"))
     ref = ctx.maybe_get(op.input("Input")[0]) if op.input("Input") else None
@@ -198,8 +201,8 @@ def _uniform_random(ctx, op):
     ctx.set_out(op, "Out", out)
 
 
-@register("gaussian_random")
-@register("gaussian_random_batch_size_like")
+@register("gaussian_random", stateful_rng=True)
+@register("gaussian_random_batch_size_like", stateful_rng=True)
 def _gaussian_random(ctx, op):
     shape = list(op.attr("shape"))
     ref = ctx.maybe_get(op.input("Input")[0]) if op.input("Input") else None
@@ -213,7 +216,7 @@ def _gaussian_random(ctx, op):
     ctx.set_out(op, "Out", out.astype(dtype))
 
 
-@register("truncated_gaussian_random")
+@register("truncated_gaussian_random", stateful_rng=True)
 def _truncated_gaussian_random(ctx, op):
     shape = tuple(op.attr("shape"))
     dtype = _np_dtype(op.attr("dtype", "float32"))

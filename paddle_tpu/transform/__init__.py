@@ -1,0 +1,36 @@
+"""paddle_tpu.transform — optimizing IR passes + automatic parallelism.
+
+The write half of the analysis story (ROADMAP direction 4): the
+reference's multi-device SSA graph builder was a *transform* tier, and
+this package gives the reproduction one.
+
+  passes        Pass / PassManager over core/program.py's IR, with a
+                built-in bitwise re-execution verifier. Shipped passes:
+                  constant_fold  evaluate all-constant pure ops into
+                                 initialized (assign_value) vars
+                  cse            common-subexpression elimination
+                  dead_op        liveness-rooted dead-op elimination
+                                 (beyond Program.prune's target walk)
+  autoparallel  enumerate valid dp/tp/pp/sp/ep DistributedStrategy
+                assignments, price them with analysis/cost.step_costs
+                + an analytic comm/bubble model calibrated against
+                PERF.md, recommend() a ranked list or apply() the top
+                plan as a configured ParallelExecutor.
+
+Arm at runtime with PADDLE_TPU_TRANSFORM=1 (pass selection via
+PADDLE_TPU_TRANSFORM_PASSES): every compile-cache miss builds from the
+transformed clone while the cache key stays the caller's program.
+
+CLI:  python -m paddle_tpu.transform --all           pass pipeline +
+                                                     verification gate
+      python -m paddle_tpu.transform --plan transformer 8
+"""
+
+from .passes import (  # noqa: F401
+    Pass, PassManager, TransformResult, ConstantFoldPass, CSEPass,
+    DeadOpEliminationPass, default_passes, passes_by_name,
+    resolve_passes, maybe_transform_for_build, verify_bitwise)
+from .autoparallel import (  # noqa: F401
+    ModelSpec, Plan, pipeline_utilization, candidates, plan_cost,
+    rank, recommend, apply, model_spec, embedding_wire_costs,
+    recommend_embedding_placement, PLANNABLE)

@@ -1,0 +1,179 @@
+"""CLI: python -m paddle_tpu.transform [models...] [--all] [...]
+     | python -m paddle_tpu.transform --plan MODEL DEVICES
+
+Pass-pipeline mode runs the optimizing passes over the Program-level
+model zoo and VERIFIES each transform by re-executing both programs
+and comparing fetches bitwise — exit 1 on any verification failure
+(the CI gate shape of python -m paddle_tpu.analysis). Planner mode
+prints the ranked dp/tp/pp/sp/ep plans for a zoo model at a device
+count. Exit codes: 0 clean, 1 gate failure, 2 bad usage (argparse).
+Run under JAX_PLATFORMS=cpu; nothing here needs a chip.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _run_pipeline(args):
+    from ..models import TRANSFORM_ZOO, transform_zoo_entry
+    from .passes import PassManager, resolve_passes, verify_bitwise
+
+    names = (sorted(TRANSFORM_ZOO) if args.all or not args.models
+             else args.models)
+    unknown = set(names) - set(TRANSFORM_ZOO)
+    if unknown:
+        print("unknown model(s) %s; --list-models for the zoo"
+              % ", ".join(sorted(unknown)), file=sys.stderr)
+        return 2
+    try:
+        passes = resolve_passes(args.passes)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not passes:
+        print("no passes selected (transform_passes=none)",
+              file=sys.stderr)
+        return 0
+
+    failed = 0
+    rows = []
+    for name in names:
+        t0 = time.perf_counter()
+        main, startup, feed_fn, fetch_names = transform_zoo_entry(name)
+        result = PassManager(passes).run(main, keep=fetch_names)
+        row = {"model": name, **result.to_dict()}
+        if args.verify:
+            ok, detail = verify_bitwise(main, startup, feed_fn,
+                                        fetch_names, result.program,
+                                        steps=args.steps)
+            row["verified"] = ok
+            if not ok:
+                row["detail"] = detail
+                failed += 1
+        row["dt_s"] = round(time.perf_counter() - t0, 3)
+        rows.append(row)
+        if not args.json:
+            tail = ""
+            if args.verify:
+                tail = ("  bitwise-identical" if row["verified"]
+                        else "  VERIFICATION FAILED: %s"
+                        % row.get("detail"))
+            print("%-16s %4d -> %4d ops (-%d: %s)%s  %.1fs"
+                  % (name, row["ops_before"], row["ops_after"],
+                     row["ops_removed"],
+                     ", ".join("%s %d" % (p, n)
+                               for p, n in row["passes"].items()),
+                     tail, row["dt_s"]))
+    if args.json:
+        print(json.dumps({"models": rows, "failed": failed}))
+    return 1 if failed else 0
+
+
+def _run_plan(args):
+    from .autoparallel import recommend
+
+    model = args.plan[0]
+    if len(args.plan) > 2:
+        print("--plan takes MODEL [DEVICES], got %r" % (args.plan,),
+              file=sys.stderr)
+        return 2
+    if len(args.plan) > 1:
+        devices = args.plan[1]
+    else:
+        # DEVICES omitted: the autoparallel_devices flag, else the
+        # visible device count
+        from .. import flags
+        devices = flags.get_flag("autoparallel_devices")
+        if not devices:
+            import jax
+            devices = jax.device_count()
+    try:
+        devices = int(devices)
+    except ValueError:
+        print("--plan DEVICES must be an integer, got %r" % devices,
+              file=sys.stderr)
+        return 2
+    if devices < 1:
+        print("--plan needs devices >= 1", file=sys.stderr)
+        return 2
+    try:
+        plans = recommend(model, devices, top=args.top or None)
+    except KeyError as e:
+        print(str(e.args[0]) if e.args else str(e), file=sys.stderr)
+        return 2
+    except ValueError as e:
+        # e.g. no valid dp/tp/pp/sp/ep assignment at this device count
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"model": model, "devices": devices,
+                          "plans": [p.to_dict() for p in plans]}))
+        return 0
+    print("ranked plans for %s at %d devices (modeled step seconds; "
+          "calibration: PERF.md):" % (model, devices))
+    for i, p in enumerate(plans):
+        b = p.breakdown
+        print("%2d. %-18s cost=%.3es  compute=%.3es util=%.2f  "
+              "comm dp/tp/pp/sp/ep = %.1e/%.1e/%.1e/%.1e/%.1e"
+              % (i + 1, p.describe(), p.cost, b["compute_s"],
+                 b["pipeline_util"], b["dp_comm_s"], b["tp_comm_s"],
+                 b["pp_comm_s"], b["sp_comm_s"], b["ep_comm_s"]))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.transform",
+        description="optimizing IR passes + automatic parallelism "
+                    "planner over the paddle_tpu model zoo")
+    p.add_argument("models", nargs="*",
+                   help="Program-zoo model names (see --list-models)")
+    p.add_argument("--all", action="store_true",
+                   help="run the pass pipeline over every Program-zoo "
+                        "model")
+    p.add_argument("--passes", default=None,
+                   help="comma list / 'all' / 'none' (default: the "
+                        "transform_passes flag)")
+    p.add_argument("--no-verify", dest="verify", action="store_false",
+                   help="skip the bitwise re-execution verification "
+                        "(rewrite + report only)")
+    p.add_argument("--steps", type=int, default=2,
+                   help="verification steps per model (default 2)")
+    p.add_argument("--plan", nargs="+", metavar="MODEL [DEVICES]",
+                   help="planner mode: ranked dp/tp/pp/sp/ep plans "
+                        "for MODEL at DEVICES chips (DEVICES defaults "
+                        "to the autoparallel_devices flag, else the "
+                        "visible device count)")
+    p.add_argument("--top", type=int, default=0,
+                   help="planner mode: only the best N plans")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of text")
+    p.add_argument("--list-passes", action="store_true")
+    p.add_argument("--list-models", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_passes:
+        from .passes import default_passes
+        for pas in default_passes():
+            print("%-14s %s" % (pas.name, pas.doc))
+        return 0
+    if args.list_models:
+        from ..models import TRANSFORM_ZOO
+        from .autoparallel import PLANNABLE
+        for name in sorted(TRANSFORM_ZOO):
+            print("%s%s" % (name,
+                            "  [plannable]" if name in PLANNABLE
+                            else ""))
+        return 0
+    if args.passes is None:
+        from .. import flags
+        args.passes = flags.get_flag("transform_passes")
+    if args.plan:
+        return _run_plan(args)
+    return _run_pipeline(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,438 @@
+"""paddle_tpu.transform pass framework: per-pass golden fixtures
+(before/after op lists pinned), the zoo property gate (every Program-zoo
+model survives the full pipeline; the bitwise re-execution verifier
+holds; at least one zoo program demonstrably shrinks), the armed
+executor path (PADDLE_TPU_TRANSFORM=1), and the monitor integration
+(ptpu_transform_* counters, transform recorder rows, transformed-
+program recompile classification).
+
+Tier-1 keeps the fast pins: goldens, the full-zoo REWRITE property
+(build + transform only), bitwise execution verification for the
+shrinking model and the MLP, and the armed-executor equality. The
+full-zoo bitwise execution sweep (two compiles per model; ~50 s of
+conv-model XLA time) runs under ``-m slow``.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.models import TRANSFORM_ZOO, transform_zoo_entry
+from paddle_tpu.transform import (
+    PassManager, CSEPass, ConstantFoldPass, DeadOpEliminationPass,
+    default_passes, resolve_passes, verify_bitwise)
+
+
+def _ops(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def _staged(build):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    return main, startup, fetches
+
+
+# -- golden fixtures: one per pass -----------------------------------------
+
+def test_cse_golden_dedups_identical_chain():
+    def build():
+        x = fluid.layers.data("x", [4])
+        a = fluid.layers.scale(x, 2.0)
+        b = fluid.layers.scale(x, 2.0)        # identical to a
+        return fluid.layers.elementwise_add(a, b)
+
+    main, startup, out = _staged(build)
+    assert _ops(main) == ["scale", "scale", "elementwise_add"]
+    result = PassManager([CSEPass()]).run(main, keep=[out.name])
+    assert _ops(result.program) == ["scale", "elementwise_add"]
+    assert result.stats["cse"] == 1
+    # the surviving add reads the first scale's output twice
+    add = result.program.global_block().ops[1]
+    ins = add.input("X") + add.input("Y")
+    assert len(set(ins)) == 1
+    # (execution identity for CSE is pinned on the real shrinking zoo
+    # model in test_zoo_demonstrably_shrinks — no compile spent here)
+
+
+def test_cse_protects_marker_attr_references():
+    """Grad markers name their dataflow in ATTRS (param_names /
+    loss_name / input_names / target_names), which the rename map
+    never rewrites — a producer of a marker-referenced name must
+    survive under its own name even when it duplicates an earlier
+    op."""
+    def build():
+        x = fluid.layers.data("x", [4])
+        y1 = fluid.layers.scale(x, 2.0)
+        y2 = fluid.layers.scale(x, 2.0)      # identical, but...
+        return y1, y2
+
+    main, _, (y1, y2) = _staged(build)
+    # ...y2 is referenced ONLY through a marker attr
+    main.global_block().append_op(
+        "calc_gradient_marker",
+        attrs={"input_names": ["x"], "target_names": [y2.name]})
+    result = PassManager([CSEPass()]).run(main, keep=[y1.name])
+    assert _ops(result.program) == \
+        ["scale", "scale", "calc_gradient_marker"]
+    assert result.stats["cse"] == 0
+
+
+def test_cse_never_touches_rng_or_inplace_ops():
+    def build():
+        x = fluid.layers.data("x", [4])
+        a = fluid.layers.dropout(x, dropout_prob=0.5)
+        b = fluid.layers.dropout(x, dropout_prob=0.5)  # distinct draws!
+        return fluid.layers.elementwise_add(a, b)
+
+    main, _, out = _staged(build)
+    result = PassManager([CSEPass()]).run(main, keep=[out.name])
+    # identical attrs/inputs, but each draws its own mask: both stay
+    assert _ops(result.program) == _ops(main)
+    assert result.stats["cse"] == 0
+
+
+def test_constant_fold_golden_folds_into_initialized_var():
+    def build():
+        x = fluid.layers.data("x", [2])
+        one = fluid.layers.fill_constant([2], "float32", 1.5)
+        two = fluid.layers.fill_constant([2], "float32", 2.0)
+        s = fluid.layers.elementwise_add(one, two)   # 3.5, compile-time
+        return fluid.layers.elementwise_add(x, s)
+
+    main, startup, out = _staged(build)
+    assert _ops(main) == ["fill_constant", "fill_constant",
+                          "elementwise_add", "elementwise_add"]
+    fold = PassManager([ConstantFoldPass()]).run(main, keep=[out.name])
+    # the const add became an initialized var (assign_value); sources stay
+    assert _ops(fold.program) == ["fill_constant", "fill_constant",
+                                  "assign_value", "elementwise_add"]
+    folded = fold.program.global_block().ops[2]
+    np.testing.assert_array_equal(folded.attr("values"),
+                                  np.full((2,), 3.5, np.float32))
+    # the full pipeline also drops the now-dead sources
+    full = PassManager(default_passes()).run(main, keep=[out.name])
+    assert _ops(full.program) == ["assign_value", "elementwise_add"]
+    assert full.stats["constant_fold"] >= 1
+    assert full.stats["dead_op"] >= 2
+
+    def feeds(rng):
+        return {"x": rng.rand(3, 2).astype(np.float32)}
+    ok, detail = verify_bitwise(main, startup, feeds, [out.name],
+                                full.program)
+    assert ok, detail
+
+
+def test_dead_op_golden_removes_chain_keeps_roots():
+    def build():
+        x = fluid.layers.data("x", [4])
+        live = fluid.layers.scale(x, 2.0)
+        d1 = fluid.layers.scale(x, 3.0)       # dead chain head
+        d2 = fluid.layers.scale(d1, 4.0)      # dead chain tail
+        d3 = fluid.layers.dropout(d2, dropout_prob=0.1)  # dead but RNG
+        del d3
+        return fluid.layers.elementwise_add(live, live)
+
+    main, startup, out = _staged(build)
+    assert _ops(main) == ["scale", "scale", "scale", "dropout",
+                          "elementwise_add"]
+    result = PassManager([DeadOpEliminationPass()]).run(
+        main, keep=[out.name])
+    # the RNG op is a stream-position root: it stays, and because it
+    # consumes the dead chain, the chain stays live through it — the
+    # conservative contract that keeps bitwise identity
+    assert _ops(result.program) == ["scale", "scale", "scale",
+                                    "dropout", "elementwise_add"]
+
+    # without the RNG tail the chain is really dead and goes away
+    def build2():
+        x = fluid.layers.data("x", [4])
+        live = fluid.layers.scale(x, 2.0)
+        d1 = fluid.layers.scale(x, 3.0)
+        d2 = fluid.layers.scale(d1, 4.0)
+        del d2
+        return fluid.layers.elementwise_add(live, live)
+
+    main2, startup2, out2 = _staged(build2)
+    r2 = PassManager([DeadOpEliminationPass()]).run(
+        main2, keep=[out2.name])
+    assert _ops(r2.program) == ["scale", "elementwise_add"]
+    assert r2.stats["dead_op"] == 2
+    # (dead-op execution identity rides test_dead_op_beyond_prune_...
+    # and the zoo sweep — no extra compile here)
+
+
+def test_dead_op_beyond_prune_keeps_training_semantics():
+    """prune(fetches) is a target slicer — it drops the optimizer ops,
+    so it cannot optimize a TRAIN program; dead_op roots on side
+    effects (persistable writes, markers) and removes exactly the dead
+    chain."""
+    def build():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 4)
+        dead = fluid.layers.scale(h, 5.0)
+        dead2 = fluid.layers.scale(dead, 5.0)
+        del dead2
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        return cost
+
+    main, startup, cost = _staged(build)
+    pruned = main.prune([cost.name])
+    assert "sgd" not in _ops(pruned)          # prune slices training away
+    result = PassManager([DeadOpEliminationPass()]).run(
+        main, keep=[cost.name])
+    kept = _ops(result.program)
+    assert kept.count("sgd") == _ops(main).count("sgd")
+    assert "backward_marker" in kept
+    assert kept.count("scale") == _ops(main).count("scale") - 2
+
+    def feeds(rng):
+        return {"x": rng.rand(4, 4).astype(np.float32),
+                "y": rng.rand(4, 1).astype(np.float32)}
+    ok, detail = verify_bitwise(main, startup, feeds, [cost.name],
+                                result.program)
+    assert ok, detail
+
+
+def test_resolve_passes_grammar():
+    assert [p.name for p in resolve_passes("all")] == \
+        ["constant_fold", "cse", "dead_op"]
+    assert resolve_passes("none") == []
+    assert [p.name for p in resolve_passes("cse,dead_op")] == \
+        ["cse", "dead_op"]
+    with pytest.raises(ValueError):
+        resolve_passes("cse,bogus")
+
+
+# -- zoo property gate ------------------------------------------------------
+
+@pytest.mark.parametrize("model", sorted(TRANSFORM_ZOO))
+def test_zoo_program_survives_pipeline(model):
+    """Every Program-zoo model runs the full pipeline: never grows, op
+    accounting consistent, meta annotated (build + rewrite only — the
+    execution identity for each model is pinned below / under slow)."""
+    main, startup, feed_fn, fetch_names = transform_zoo_entry(model)
+    before = len(main.global_block().ops)
+    result = PassManager(default_passes()).run(main, keep=fetch_names)
+    assert result.ops_before == before
+    assert result.ops_after <= result.ops_before
+    assert result.ops_after == len(result.program.global_block().ops)
+    meta = result.program._transform_meta
+    assert meta["parent_version"] == main._version
+    assert meta["version"] == result.program._version
+    # the original program was never mutated
+    assert len(main.global_block().ops) == before
+
+
+def test_zoo_demonstrably_shrinks():
+    """At least one zoo program shrinks under the pipeline: the MT
+    transformer derives two attention biases from src_mask through
+    identical chains — CSE removes the duplicate (ops_removed > 0),
+    and the transformed program stays bitwise-identical in execution."""
+    main, startup, feed_fn, fetch_names = \
+        transform_zoo_entry("transformer_mt")
+    result = PassManager(default_passes()).run(main, keep=fetch_names)
+    assert result.ops_removed >= 3
+    assert result.stats["cse"] >= 3
+    ok, detail = verify_bitwise(main, startup, feed_fn, fetch_names,
+                                result.program)
+    assert ok, detail
+
+
+@pytest.mark.slow
+def test_zoo_mlp_bitwise_identity():
+    """Execution-identity for the no-shrink case (Adam train step:
+    optimizer roots, marker, accuracy path). Slow tier: tier-1 already
+    pins execution identity via the shrinking model and the armed-
+    executor equality; this representative rides the full-zoo sweep."""
+    main, startup, feed_fn, fetch_names = transform_zoo_entry("mlp")
+    result = PassManager(default_passes()).run(main, keep=fetch_names)
+    ok, detail = verify_bitwise(main, startup, feed_fn, fetch_names,
+                                result.program)
+    assert ok, detail
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", sorted(TRANSFORM_ZOO))
+def test_zoo_bitwise_identity_full(model):
+    """The full acceptance sweep: EVERY zoo program executes
+    bitwise-identically after the full pipeline (two XLA compiles per
+    model — the conv models make this a slow-tier soak; tier-1 pins
+    the representative pair above)."""
+    main, startup, feed_fn, fetch_names = transform_zoo_entry(model)
+    result = PassManager(default_passes()).run(main, keep=fetch_names)
+    ok, detail = verify_bitwise(main, startup, feed_fn, fetch_names,
+                                result.program)
+    assert ok, detail
+
+
+# -- armed executor + monitor integration ----------------------------------
+
+def _tiny_train(batch=4):
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.data("y", [1])
+    one = fluid.layers.fill_constant([1], "float32", 1.0)
+    two = fluid.layers.fill_constant([1], "float32", 1.0)  # CSE food
+    h = fluid.layers.fc(x, 8, act="relu")
+    dead = fluid.layers.scale(h, 2.0)
+    del dead
+    pred = fluid.layers.fc(h, 1)
+    pred = fluid.layers.elementwise_add(
+        pred, fluid.layers.elementwise_sub(one, two))      # +0, folds
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return cost
+
+
+def _tiny_feeds(rng, batch=4):
+    return {"x": rng.rand(batch, 4).astype(np.float32),
+            "y": rng.rand(batch, 1).astype(np.float32)}
+
+
+def test_armed_executor_transforms_at_compile(tmp_path):
+    """PADDLE_TPU_TRANSFORM=1: the compile path builds from the
+    transformed clone — losses identical to the unarmed run, one cache
+    entry (hits never re-transform), counters + recorder rows land."""
+    from paddle_tpu import flags
+    from paddle_tpu.monitor.runtime import (TRANSFORM_PASSES,
+                                            TRANSFORM_OPS_REMOVED)
+
+    batches = [_tiny_feeds(np.random.RandomState(i)) for i in range(3)]
+
+    def run_once():
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope):
+            cost = _tiny_train()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [float(np.asarray(
+                exe.run(feed=f, fetch_list=[cost])[0]))
+                for f in batches]
+        return losses, exe
+
+    base_losses, _ = run_once()
+    removed0 = sum(TRANSFORM_OPS_REMOVED.snapshot().values())
+    passes0 = sum(TRANSFORM_PASSES.snapshot().values())
+    log = tmp_path / "transform.jsonl"
+    flags.set_flag("transform", True)
+    try:
+        with monitor.session(log_path=str(log)):
+            armed_losses, exe = run_once()
+    finally:
+        flags.set_flag("transform", None)
+    assert armed_losses == base_losses
+    # 1 startup entry + ONE main entry for 3 runs: cache hits never
+    # re-transform
+    assert len(exe._cache) == 2
+    assert sum(TRANSFORM_PASSES.snapshot().values()) > passes0
+    assert sum(TRANSFORM_OPS_REMOVED.snapshot().values()) > removed0
+    rows = [r for r in monitor.read_jsonl(str(log))
+            if r.get("ev") == "transform"]
+    assert rows, "armed transform must land transform recorder rows"
+    r = rows[0]
+    assert {"program", "version", "pass", "ops_before", "ops_after",
+            "dt"} <= set(r)
+    # constant folding REPLACES ops in place: its row must report its
+    # change count, not the (zero) op-count delta
+    fold_rows = [r for r in rows
+                 if r["pass"] == "constant_fold" and r["removed"]]
+    assert fold_rows, "fold activity must be visible in removed"
+    # ARMED-path classification: the compile hook sees the CALLER's
+    # program, which mirrors the clone's meta as _transform_applied —
+    # the compile is attributed to the transform, not mystery-counted
+    compiles = [r for r in monitor.read_jsonl(str(log))
+                if r.get("ev") == "compile"]
+    assert any(r["reason"] == "transformed_program" and
+               "transform_of" in r for r in compiles)
+
+
+def test_armed_transform_memoizes_per_version():
+    """Repeated compile-cache misses of one program (e.g. feed-
+    signature churn) must not re-run the pipeline: the clone memoizes
+    on the original per (version, passes, fetch set); a program
+    MUTATION (version bump) re-transforms."""
+    from paddle_tpu import flags
+    from paddle_tpu.monitor.runtime import TRANSFORM_PASSES
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        cost = _tiny_train()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        flags.set_flag("transform", True)
+        try:
+            n0 = sum(TRANSFORM_PASSES.snapshot().values())
+            exe.run(feed=_tiny_feeds(np.random.RandomState(0)),
+                    fetch_list=[cost])
+            n1 = sum(TRANSFORM_PASSES.snapshot().values())
+            assert n1 > n0                      # transformed once
+            # new feed SIGNATURE -> compile miss, but memoized clone
+            exe.run(feed=_tiny_feeds(np.random.RandomState(0), batch=6),
+                    fetch_list=[cost])
+            assert sum(TRANSFORM_PASSES.snapshot().values()) == n1
+            # program mutation -> version bump -> fresh transform
+            fluid.layers.scale(cost, 1.0)
+            exe.run(feed=_tiny_feeds(np.random.RandomState(0)),
+                    fetch_list=[cost])
+            assert sum(TRANSFORM_PASSES.snapshot().values()) > n1
+        finally:
+            flags.set_flag("transform", None)
+        # DISARMED compile of the same program drops the stale
+        # _transform_applied mirror: a genuinely untransformed compile
+        # must not keep classifying as transformed_program
+        assert getattr(main, "_transform_applied", None) is not None
+        fluid.layers.scale(cost, 1.0)       # version bump -> new key
+        exe.run(feed=_tiny_feeds(np.random.RandomState(0)),
+                fetch_list=[cost])
+        assert getattr(main, "_transform_applied", None) is None
+
+
+def test_transformed_program_recompile_classified(tmp_path):
+    """A PassManager clone carries _transform_meta: its first compile
+    is classified 'transformed_program' (with the parent version in
+    the row), not mystery-counted as new_program."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    log = tmp_path / "classify.jsonl"
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        cost = _tiny_train()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        result = PassManager(default_passes()).run(
+            main, keep=[cost.name])
+        with monitor.session(log_path=str(log)):
+            exe.run(result.program, feed=_tiny_feeds(
+                np.random.RandomState(0)), fetch_list=[cost.name])
+    rows = [r for r in monitor.read_jsonl(str(log))
+            if r.get("ev") == "compile"]
+    assert rows and rows[0]["reason"] == "transformed_program"
+    assert rows[0]["transform_of"] == \
+        result.program._transform_meta["parent_version"]
+
+
+def test_cli_pipeline_and_plan_usage():
+    """CLI surface: list modes + usage errors are cheap to pin (the
+    heavy verified pipeline run is the slow-tier / bench surface)."""
+    from paddle_tpu.transform.__main__ import main as cli
+    assert cli(["--list-passes"]) == 0
+    assert cli(["--list-models"]) == 0
+    assert cli(["no_such_model"]) == 2
+    assert cli(["--plan", "mlp", "8"]) == 2          # not plannable
+    assert cli(["--plan", "transformer", "zero"]) == 2
+    assert cli(["--passes", "bogus", "mlp"]) == 2
+
+
+def test_cli_plan_infeasible_devices_is_usage_error():
+    """A device count no axis assignment can satisfy (7 is coprime
+    with batch=8, heads=4, layers=2, seq=32) must exit 2 with the
+    planner's message, not crash with a traceback."""
+    from paddle_tpu.transform.__main__ import main as cli
+    assert cli(["--plan", "transformer", "7"]) == 2
