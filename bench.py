@@ -261,8 +261,13 @@ def main():
         try:
             _fresh()
             # --megastep 8: the ISSUE-7 fused-K decode pass rides the
-            # same probe, stamped as megastep_* fields in the block
-            _run(["--device", "CPU", "--fast", "--megastep", "8"])
+            # same probe, stamped as megastep_* fields in the block.
+            # --prefix_share 32: the ISSUE-10 shared-system-prompt A/B
+            # (paged+prefix vs PR-5 dense, interleaved windows) rides
+            # it too, stamped as prefix_* fields alongside the paged
+            # pool occupancy (kv_*)
+            _run(["--device", "CPU", "--fast", "--megastep", "8",
+                  "--prefix_share", "32"])
             import serving_bench as smod
             return importlib.reload(smod).main()
         finally:

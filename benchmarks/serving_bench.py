@@ -38,6 +38,92 @@ def build_requests(rng, n, vocab, max_prompt, min_new, max_new):
     return reqs
 
 
+def _prefix_share_ab(args, infer, eng):
+    """Shared-system-prompt A/B (ISSUE 10): a request set that all
+    opens with the same ``--prefix_share``-token system prompt,
+    decoded through the PAGED+prefix engine (``eng``, cache warm after
+    the first window) and a fresh PR-5 DENSE engine, in interleaved
+    windows (round-5 protocol). Stamps tokens/s both arms, the
+    prefill chunks each arm actually executed (the measured
+    prefill-compute saving), the paged arm's prefix hit rate, and
+    token identity of both arms against the sequential baseline."""
+    import statistics
+    rng = np.random.RandomState(args.seed + 1)
+    n = max(8, min(args.requests, 16))
+    tail_max = max(2, min(6, args.max_prompt))
+    headroom = args.max_len - args.prefix_share - tail_max
+    if headroom < 2:
+        # reject the flag combination up front — letting it through
+        # would abort the whole bench inside Engine.submit's max_len
+        # bound mid-measurement
+        raise SystemExit(
+            "--prefix_share %d leaves no decode headroom at "
+            "--max_len %d (need prefix + %d-token tail + >=2 new "
+            "tokens)" % (args.prefix_share, args.max_len, tail_max))
+    sysp = [1] + rng.randint(3, args.vocab,
+                             args.prefix_share - 1).tolist()
+    new_cap = min(args.max_new, headroom)
+    new_min = min(args.min_new, new_cap)
+    psreqs = []
+    for _ in range(n):
+        tail = rng.randint(
+            3, args.vocab, int(rng.randint(1, tail_max + 1))).tolist()
+        psreqs.append((sysp + tail,
+                       int(rng.randint(new_min, new_cap + 1))))
+    seq_ps = serving.sequential_generate(infer, psreqs)
+    total = sum(len(t) for t, _ in seq_ps)
+    dense = serving.Engine(infer, slots=args.slots,
+                           prefill_chunk=args.prefill_chunk,
+                           paged=False, name="engine-dense")
+
+    def run_set(engine):
+        t0 = time.perf_counter()
+        handles = [engine.submit(p, m) for p, m in psreqs]
+        res = [h.result() for h in handles]
+        return time.perf_counter() - t0, res
+
+    run_set(dense), run_set(eng)        # warm compiles + prefix cache
+    h0, m0 = eng.stats["prefix_hits"], eng.stats["prefix_misses"]
+    cp0, cd0 = eng.stats["prefill_chunks"], dense.stats["prefill_chunks"]
+    wins = 1 if args.fast else 3
+    da, dp, identical = [], [], True
+    for _ in range(wins):               # interleaved A/B
+        dt, res = run_set(dense)
+        da.append(dt)
+        identical = identical and all(
+            st == rt for (st, _), (rt, _) in zip(seq_ps, res))
+        dt, res = run_set(eng)
+        dp.append(dt)
+        identical = identical and all(
+            st == rt for (st, _), (rt, _) in zip(seq_ps, res))
+    dense_chunks = dense.stats["prefill_chunks"] - cd0
+    paged_chunks = eng.stats["prefill_chunks"] - cp0
+    hits = eng.stats["prefix_hits"] - h0
+    miss = eng.stats["prefix_misses"] - m0
+    dense.close()
+    md, mp = statistics.median(da), statistics.median(dp)
+    out = {
+        "prefix_share": args.prefix_share,
+        "prefix_requests": n,
+        "prefix_windows": wins,
+        "prefix_dense_tok_s": round(total * 1.0 / md, 1),
+        "prefix_paged_tok_s": round(total * 1.0 / mp, 1),
+        "prefix_speedup": round(md / mp, 2),
+        "prefix_chunks_dense": dense_chunks,
+        "prefix_chunks_paged": paged_chunks,
+        "prefix_hit_rate": round(hits / (hits + miss), 3)
+        if hits + miss else None,
+        "prefix_identical": bool(identical),
+    }
+    print("prefix-share A/B (%d-token system prompt, %d reqs): paged "
+          "%.0f vs dense %.0f tok/s (%.2fx), chunks %d vs %d, hit "
+          "rate %s, identical=%s"
+          % (args.prefix_share, n, total / mp, total / md, md / mp,
+             paged_chunks, dense_chunks, out["prefix_hit_rate"],
+             identical), file=sys.stderr)
+    return out
+
+
 def main():
     args = parse_args(
         "serving_bench", batch_size=0, iterations=1, skip=0,
@@ -59,6 +145,14 @@ def main():
                                 "engine pass (ISSUE 7): K decode "
                                 "iterations per dispatch, stamped as "
                                 "megastep_* fields (0 = skip)"),
+            p.add_argument("--prefix_share", type=int, default=0,
+                           help="also measure a shared-system-prompt "
+                                "A/B (ISSUE 10): every request opens "
+                                "with the same N-token prefix; "
+                                "interleaved windows of the paged+"
+                                "prefix engine vs the PR-5 dense "
+                                "layout, stamped as prefix_* fields "
+                                "(0 = skip)"),
             p.add_argument("--fast", action="store_true",
                            help="tier-1 CPU smoke: smaller request set")))
     import jax
@@ -201,6 +295,17 @@ def _run_bench(args):
               "tok/s (%.2fx)" % (k1, args.megastep, k8, k8 / k1),
               file=sys.stderr)
         eng2.close()
+
+    if args.prefix_share > 0 and eng._paged:
+        out.update(_prefix_share_ab(args, infer, eng))
+
+    if eng._paged:
+        # pool stats of the main pass (the paged engine's whole run)
+        out["kv_pool_blocks"] = eng._pool.num_blocks
+        out["kv_peak_blocks"] = eng.stats["kv_peak_blocks"]
+        out["kv_peak_occupancy"] = round(
+            eng.stats["kv_peak_blocks"] / eng._pool.num_blocks, 3)
+        out["preemptions"] = eng.stats["preemptions"]
     eng.close()
 
     ttft = [h.ttft for h in handles]

@@ -23,6 +23,8 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.serving",
     "paddle_tpu.serving.engine",
     "paddle_tpu.serving.fleet",
+    "paddle_tpu.serving.kvpool",
+    "paddle_tpu.serving.sampling",
     "paddle_tpu.reader",
     "paddle_tpu.reader.device_loader",
     "paddle_tpu.slo",

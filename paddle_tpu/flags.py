@@ -34,9 +34,11 @@ Registered flags:
                         autoparallel planner's default device count
   serving*        —     paddle_tpu.serving continuous-batching engine
                         knobs (prefill chunk length, admission window,
-                        fused decode megastep K) and serving.fleet
-                        router knobs (per-replica in-flight window,
-                        global shed bound, stall-watchdog deadline)
+                        fused decode megastep K, paged-KV layout /
+                        block size / pool size / prefix cache) and
+                        serving.fleet router knobs (per-replica
+                        in-flight window, global shed bound,
+                        stall-watchdog deadline)
   megastep_inflight int Executor.run_steps async dispatch window depth
                         (2 = double buffering)
   slo_spec        str   default SLO spec JSON for python -m
@@ -193,6 +195,28 @@ _register("serving_megastep", int, 1,
           "retirement bookkeeping land at megastep boundaries; output "
           "stays token-identical to the K=1 engine. 1 = one dispatch "
           "per decode step (the PR-5 behavior)")
+_register("serving_paged", bool, True,
+          "serving.Engine KV layout: paged block pool + per-slot "
+          "block tables (the vLLM design — short requests stop "
+          "reserving max_len worth of cache, shared prefixes share "
+          "blocks). 0 restores the PR-5 dense [slots, ...] cache; "
+          "greedy output is token-identical either way")
+_register("serving_block_size", int, 16,
+          "paged-KV block length (cache positions per block): the "
+          "allocation granule, the prefix-cache match granule (only "
+          "full-block prompt prefixes are cached/matched), and the "
+          "COW copy unit")
+_register("serving_kv_blocks", int, 0,
+          "paged-KV pool size in blocks. 0 = auto: slots * "
+          "ceil(max_len / block_size), dense-capacity parity — size "
+          "it below that to trade concurrency headroom for memory "
+          "(the engine preempts the lowest-priority request when the "
+          "pool runs dry)")
+_register("serving_prefix_cache", bool, True,
+          "radix prefix cache over prompt blocks: an admission whose "
+          "prompt shares a cached full-block prefix skips those "
+          "prefill chunks entirely (refcounted chains, LRU eviction "
+          "under pool pressure). Requires serving_paged")
 _register("serving_fleet_window", int, 8,
           "serving.fleet Router per-replica in-flight window "
           "(backpressure): at most this many journaled requests are "
@@ -237,6 +261,12 @@ _register("autoparallel_devices", int, 0,
           "default device count for the automatic parallelism planner "
           "(python -m paddle_tpu.transform --plan / "
           "transform.recommend); 0 = jax.device_count() at call time")
+_register("autoparallel_hbm_gb", float, 0.0,
+          "per-chip HBM capacity (GB) the autoparallel planner "
+          "filters against: candidates whose modeled per-chip bytes "
+          "(param shard + optimizer state + paged-KV pool, "
+          "transform.autoparallel.plan_hbm_bytes) exceed it are "
+          "REJECTED, not ranked. 0 = no capacity filter")
 _register("fuse_conv_bn", bool, False,
           "fuse 1x1-conv + train-BN batch stats into one Pallas matmul "
           "epilogue (ops/matmul_stats.py). Default OFF: measured SLOWER "

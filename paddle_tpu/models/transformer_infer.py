@@ -364,6 +364,115 @@ class TransformerLMInfer(TransformerInfer):
             x = _ln(x + self._ffn(p, x), *p["ln2"])
         return x[:, 0, :] @ self.w_out, state
 
+    # -- paged KV (serving.kvpool block pool, ISSUE 10) ----------------
+    def _init_paged_state(self, num_blocks, block_size):
+        """Shared paged KV pool: K and V arrays of shape
+        ``[num_blocks, n_layer, n_head, block_size, dk]``. Slots map
+        logical cache positions to physical blocks through per-slot
+        block tables (``serving.kvpool.BlockPool`` owns the host-side
+        accounting); unassigned table entries read block 0, whose
+        garbage the causal bias masks exactly like the dense path
+        masks a recycled slot's stale tail."""
+        dk = self.d_model // self.n_head
+        dtype = self.word_emb.dtype
+        shape = (int(num_blocks), self.n_layer, self.n_head,
+                 int(block_size), dk)
+        return {"pool_k": jnp.zeros(shape, dtype),
+                "pool_v": jnp.zeros(shape, dtype)}
+
+    def _step_logits_paged(self, tok, state, pos, btab, write_mask=None):
+        """Per-slot incremental step over the PAGED pool: like
+        ``_step_logits_slots`` but each slot's K/V live in the shared
+        block pool, addressed through its block table ``btab``
+        [S, max_blocks] int32. The gathered per-slot cache is SLICED
+        back to ``[S, H, max_len, dk]`` before attention, so position
+        j of the key axis is logical position j and every reduction
+        runs over the exact dense-path axis length — greedy logits are
+        bitwise the dense step's (token identity by construction, not
+        by tolerance; pinned in tests/test_serving.py which runs the
+        whole suite over this path)."""
+        nb, bs = state["pool_k"].shape[0], state["pool_k"].shape[3]
+        s = tok.shape[0]
+        dk = self.d_model // self.n_head
+        x = self.word_emb[tok] * (self.d_model ** 0.5) + self.pos_emb[pos]
+        x = x[:, None, :]                                # [S, 1, D]
+        ar = jnp.arange(self.max_len)
+        self_bias = jnp.where(ar[None, :] <= pos[:, None], 0.0,
+                              -1e9)[:, None, None, :]    # [S, 1, 1, L]
+        blk = pos // bs
+        off = pos % bs
+        phys = jnp.take_along_axis(btab, blk[:, None], axis=1)[:, 0]
+        # masked-out rows write at num_blocks, which mode="drop"
+        # discards (the write-mask semantics of the dense path)
+        wphys = phys if write_mask is None else \
+            jnp.where(write_mask, phys, nb)
+        pool_k, pool_v = state["pool_k"], state["pool_v"]
+        for i, p in enumerate(self.layers):
+            k_new, v_new = self._kv(p["attn"], x)        # [S, H, 1, dk]
+            pool_k = pool_k.at[wphys, i, :, off, :].set(
+                k_new[:, :, 0, :], mode="drop")
+            pool_v = pool_v.at[wphys, i, :, off, :].set(
+                v_new[:, :, 0, :], mode="drop")
+            # gather THIS slot's blocks back into position order; the
+            # [:, :, :max_len] slice drops the last block's padding
+            # tail so the key axis is the dense path's, bit for bit
+            gk = pool_k[:, i][btab]          # [S, NB, H, bs, dk]
+            gv = pool_v[:, i][btab]
+            k = gk.transpose(0, 2, 1, 3, 4).reshape(
+                s, self.n_head, -1, dk)[:, :, :self.max_len]
+            v = gv.transpose(0, 2, 1, 3, 4).reshape(
+                s, self.n_head, -1, dk)[:, :, :self.max_len]
+            a = self._mha(p["attn"], x, k, v, self_bias)
+            x = _ln(x + a, *p["ln1"])
+            x = _ln(x + self._ffn(p, x), *p["ln2"])
+        state["pool_k"], state["pool_v"] = pool_k, pool_v
+        return x[:, 0, :] @ self.w_out, state
+
+    def _prefill_chunk_paged(self, state, toks, start, n_valid,
+                             btab_row):
+        """Teacher-forced chunk prefill into the paged pool for ONE
+        slot whose block table is ``btab_row`` [max_blocks] int32: the
+        paged twin of ``_prefill_chunk_slot`` (same fixed chunk shape,
+        masked padded tail, output head dead-coded). A prefix-cache
+        hit never reaches here for the cached positions — the engine
+        advances the cursor past them — but the chunk's attention DOES
+        read the shared cached blocks through the table."""
+        nb, bs = state["pool_k"].shape[0], state["pool_k"].shape[3]
+        dk = self.d_model // self.n_head
+        c = toks.shape[0]
+        idx = jnp.arange(c)
+        cpos = start + idx                               # [C]
+        valid = idx < n_valid
+        gather_pos = jnp.where(valid,
+                               jnp.minimum(cpos, self.max_len - 1), 0)
+        x = self.word_emb[toks] * (self.d_model ** 0.5) \
+            + self.pos_emb[gather_pos]
+        x = x[None]                                      # [1, C, D]
+        ar = jnp.arange(self.max_len)
+        bias = jnp.where(ar[None, :] <= cpos[:, None], 0.0,
+                         -1e9)[None, None, :, :]         # [1, 1, C, L]
+        blk = jnp.minimum(cpos // bs, btab_row.shape[0] - 1)
+        off = cpos % bs
+        wphys = jnp.where(valid, btab_row[blk], nb)      # OOB → dropped
+        pool_k, pool_v = state["pool_k"], state["pool_v"]
+        for i, p in enumerate(self.layers):
+            k_new, v_new = self._kv(p["attn"], x)        # [1, H, C, dk]
+            pool_k = pool_k.at[wphys, i, :, off, :].set(
+                k_new[0].transpose(1, 0, 2), mode="drop")
+            pool_v = pool_v.at[wphys, i, :, off, :].set(
+                v_new[0].transpose(1, 0, 2), mode="drop")
+            gk = pool_k[:, i][btab_row]      # [NB, H, bs, dk]
+            gv = pool_v[:, i][btab_row]
+            k = gk.transpose(1, 0, 2, 3).reshape(
+                self.n_head, -1, dk)[None][:, :, :self.max_len]
+            v = gv.transpose(1, 0, 2, 3).reshape(
+                self.n_head, -1, dk)[None][:, :, :self.max_len]
+            a = self._mha(p["attn"], x, k, v, bias)
+            x = _ln(x + a, *p["ln1"])
+            x = _ln(x + self._ffn(p, x), *p["ln2"])
+        state["pool_k"], state["pool_v"] = pool_k, pool_v
+        return state
+
     def _prefill_chunk_slot(self, state, slot, toks, start, n_valid):
         """Teacher-forced chunk prefill for ONE slot: write the K/V of
         ``toks[:n_valid]`` at cache positions ``start..start+n_valid-1``.
@@ -458,29 +567,31 @@ def analysis_entry_infer():
 def analysis_entry_serving_megastep():
     """Static-analyzer entry for the ISSUE-7 fused-K serving decode:
     the continuous-batching engine's megastep body — K=4 slot decode
-    iterations (``_step_logits_slots`` + greedy sampling state) scanned
-    into ONE device program over the ``[slots, ...]`` KV-cache state.
-    Traces the REAL ``serving.Engine._megastep_impl`` so the
-    recompile-hazard rule's scanned-unit heuristic sees the production
-    fused body (K is a static trace constant: varying it recompiles
-    the whole unit), and the dtype rule audits the megastep at the
-    same bf16-weights / f32-score precision contract as the plain
-    decode entry."""
+    iterations (``_step_logits_paged`` through the per-slot block
+    tables + the greedy/sampled per-slot state) scanned into ONE
+    device program over the shared paged-KV pool. Traces the REAL
+    ``serving.Engine._megastep_impl`` so the recompile-hazard rule's
+    scanned-unit heuristic sees the production fused body (K is a
+    static trace constant: varying it recompiles the whole unit), and
+    the dtype rule audits the megastep at the same bf16-weights /
+    f32-score precision contract as the plain decode entry."""
     from ..serving.engine import Engine
 
     infer = _small_lm_for_analysis(dtype=jnp.bfloat16)
     eng = Engine(infer, slots=2, prefill_chunk=4, megastep=4,
                  name="analysis")
     # tracing only: the scheduler thread is stopped before the entry is
-    # handed to the analyzer (megastep_impl is a pure function of state)
+    # handed to the analyzer (megastep_impl is a pure function of
+    # state + block tables)
     eng.close()
     params = {n: getattr(infer, n) for n in _LM_PNAMES}
     state = dict(eng._state)
+    btab = eng._btab_all()
 
-    def fn(params, state):
+    def fn(params, state, btab):
         for n in _LM_PNAMES:
             setattr(infer, n, params[n])
-        state, emits, fins = eng._megastep_impl(state)
+        state, emits, fins = eng._megastep_impl(state, btab)
         return emits, fins, state["score"]
 
-    return fn, (params, state)
+    return fn, (params, state, btab)

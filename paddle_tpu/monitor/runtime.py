@@ -133,6 +133,28 @@ SERVING_TPOT = _REG.histogram(
 SERVING_QUEUE_WAIT = _REG.histogram(
     "ptpu_serving_queue_wait_seconds",
     "request wait from submit to decode-slot admission", ("engine",))
+# paged-KV / prefix-cache tier (ISSUE 10): pool pressure and reuse.
+# Gauges reflect the engine's last iteration; counters accumulate
+KV_BLOCKS_TOTAL = _REG.gauge(
+    "ptpu_kv_blocks_total",
+    "physical blocks in the paged KV pool")
+KV_BLOCKS_USED = _REG.gauge(
+    "ptpu_kv_blocks_used",
+    "paged KV blocks referenced by live requests or the prefix cache")
+PREFIX_HITS = _REG.counter(
+    "ptpu_prefix_cache_hits_total",
+    "admissions whose prompt matched a cached prefix chain (those "
+    "prefill chunks are skipped)")
+PREFIX_MISSES = _REG.counter(
+    "ptpu_prefix_cache_misses_total",
+    "admissions with no cached prefix (cold prefill)")
+PREFIX_EVICTIONS = _REG.counter(
+    "ptpu_prefix_cache_evictions_total",
+    "prefix-cache blocks LRU-freed under pool pressure")
+SERVING_PREEMPTIONS = _REG.counter(
+    "ptpu_serving_preemptions_total",
+    "requests preempted (blocks freed, re-queued for re-prefill) "
+    "when the KV pool ran dry")
 SERVING_STEP_SECONDS = _REG.histogram(
     "ptpu_serving_step_seconds",
     "wall time of one engine iteration (prefill chunk + decode step; "
@@ -715,7 +737,8 @@ def on_checkpoint(step, path, mode):
 
 def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
                     retired=0, engine="engine", dt=None, k=1,
-                    dispatched=None):
+                    dispatched=None, kv_used=None, kv_total=None,
+                    prefix_hits=None, prefix_misses=None, preempted=0):
     """One engine iteration completed: gauges reflect the step, counters
     accumulate, and (recorder armed) a ``serving_step`` row lands with
     the step wall time and the active trace id so the fleet timeline
@@ -726,11 +749,22 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
     live slot retires early. The histogram observes (and the row
     reports) the PER-LOGICAL-STEP figure dt/dispatched, once per
     consumed step, so SLO step_latency gates stay comparable across
-    K and a drain-tail dispatch cannot overstate per-step latency."""
+    K and a drain-tail dispatch cannot overstate per-step latency.
+    Paged engines additionally report pool pressure (``kv_used`` /
+    ``kv_total`` into the kv gauges and a ``kv_used_blocks`` row field
+    the SLO engine and ``monitor watch`` gate on), cumulative prefix
+    hit/miss counts, and ``preempted`` (requests pushed back to the
+    queue this iteration)."""
     k = max(1, int(k))
     d = max(k, int(dispatched or k))
     SERVING_QUEUE_DEPTH.set(queue_depth)
     SERVING_SLOT_OCCUPANCY.set(active / slots if slots else 0.0)
+    if kv_total is not None:
+        KV_BLOCKS_TOTAL.set(kv_total)
+    if kv_used is not None:
+        KV_BLOCKS_USED.set(kv_used)
+    if preempted:
+        SERVING_PREEMPTIONS.inc(preempted)
     if emitted:
         SERVING_TOKENS.inc(emitted)
     if admitted:
@@ -748,10 +782,32 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
     if rec is not None:
         extra = {} if d == 1 else {"k": k, "megastep_dt": dt,
                                    "dispatched": d}
+        if kv_used is not None:
+            # pool-pressure fields (paged engines only — dense rows
+            # keep their PR-6 shape): kv_used_blocks is what slo/watch
+            # windows gate on; the prefix counters are CUMULATIVE so a
+            # window's hit rate is last-row arithmetic, not a sum
+            extra["kv_used_blocks"] = kv_used
+            extra["kv_total_blocks"] = kv_total
+            extra["prefix_hits"] = prefix_hits
+            extra["prefix_misses"] = prefix_misses
+            if preempted:
+                extra["preempted"] = preempted
         rec.record("serving_step", engine=engine, active=active,
                    slots=slots, queue_depth=queue_depth,
                    emitted=emitted, admitted=admitted, retired=retired,
                    dt=per, **extra, **_trace_extra())
+
+
+def on_prefix_lookup(hit):
+    """One prefix-cache lookup at admission (paged engines)."""
+    (PREFIX_HITS if hit else PREFIX_MISSES).inc()
+
+
+def on_prefix_evictions(n=1):
+    """Prefix-cache blocks LRU-freed under pool pressure."""
+    if n:
+        PREFIX_EVICTIONS.inc(n)
 
 
 def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,

@@ -72,6 +72,7 @@ class WatchState:
         self.total_serving_steps = 0
         self.total_requests = 0
         self.total_errors = 0
+        self.total_preemptions = 0
         self.total_train_steps = 0
         self.stalls = 0
         self.nan_trips = 0
@@ -105,6 +106,7 @@ class WatchState:
             # a fused megastep row advances k logical steps (dt stays
             # per-logical-step) — weight so totals are K-comparable
             self.total_serving_steps += int(e.get("k") or 1)
+            self.total_preemptions += int(e.get("preempted") or 0)
             self.serving_steps.append(e)
         elif ev == "serving_request":
             self.total_requests += 1
@@ -171,6 +173,30 @@ def render_frame(state, path, slo_verdict=None, now=None):
                "n/a" if tps is None else "%.0f" % tps, occ,
                last.get("queue_depth", 0),
                _ms(_p(dts, 0.50)), _ms(_p(dts, 0.95))))
+    kv_last = {}
+    for s in state.serving_steps:
+        if s.get("kv_used_blocks") is not None:
+            # LAST row PER ENGINE: a fleet writes one log per replica,
+            # and reading only the globally-last row would render one
+            # arbitrary replica's pool as the fleet's (the
+            # single-replica-flatters-the-fleet distortion the PR-8
+            # multi-log union exists to avoid)
+            kv_last[s.get("engine") or "engine"] = s
+    if kv_last:
+        # occupancy sums the per-engine gauges; hit rate sums the
+        # cumulative counters each engine's rows carry (last-row
+        # arithmetic per engine, never a window sum)
+        rows = list(kv_last.values())
+        used = sum(r["kv_used_blocks"] for r in rows)
+        total = sum(r["kv_total_blocks"] for r in rows)
+        h = sum(r.get("prefix_hits") or 0 for r in rows)
+        m = sum(r.get("prefix_misses") or 0 for r in rows)
+        rate = "n/a" if h + m == 0 else "%.0f%%" % (100.0 * h / (h + m))
+        lines.append(
+            "kv        blocks %d/%d (%.0f%%)   prefix hits %d "
+            "misses %d (hit rate %s)   preemptions %d"
+            % (used, total, 100.0 * used / total if total else 0.0,
+               h, m, rate, state.total_preemptions))
     if state.requests:
         # failed rows are error-budget-only (same policy as the SLO
         # engine — this line and the verdict line below must agree)

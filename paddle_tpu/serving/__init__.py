@@ -35,6 +35,16 @@ id, token-identical on re-execution (greedy decode). A ``Supervisor``
 respawns dead/evicted replicas. Chaos-gated by tests/test_fleet.py the
 way test_chaos.py gates training resilience.
 
+Paged KV + prefix reuse + sampling (ISSUE 10): the engine's default
+KV layout is a shared block pool (``kvpool.BlockPool``) addressed
+through per-slot block tables, with a radix prefix cache
+(``kvpool.RadixCache``) that lets admissions sharing a cached prompt
+prefix skip those prefill chunks entirely, copy-on-write for shared
+blocks, and preemption (lowest-priority request re-queued for
+re-prefill, output unchanged) when the pool runs dry. Per-request
+``SamplingParams`` (temperature / top-k / top-p / seed) execute inside
+the compiled step; temperature-0 stays bitwise-greedy.
+
 Request-level observability (ISSUE 6): every ``Request`` handle
 carries its lifecycle attribution after retirement — ``queue_wait``,
 ``ttft``, ``tpot``, ``prefill_chunks``, ``latency()`` — mirrored into
@@ -49,7 +59,11 @@ from .engine import (Engine, Request,  # noqa: F401
                      sequential_generate)
 from .fleet import (Overloaded, Replica, ReplicaClient,  # noqa: F401
                     ReplicaServer, Router, Supervisor)
+from .kvpool import (BlockPool, RadixCache,  # noqa: F401
+                     bytes_per_block)
+from .sampling import SamplingParams  # noqa: F401
 
 __all__ = ["Engine", "Request", "sequential_generate", "Router",
            "Replica", "ReplicaServer", "ReplicaClient", "Supervisor",
-           "Overloaded"]
+           "Overloaded", "BlockPool", "RadixCache", "bytes_per_block",
+           "SamplingParams"]

@@ -25,6 +25,26 @@ Orca (iteration-level scheduling) and vLLM (slot/block-managed caches):
     (PERF.md rounds 5/6) while staying token-identical; pending work
     forces a K→1 boundary first. ``warmup()`` compiles both dispatch
     paths before traffic.
+  * **Paged KV + prefix reuse + sampling** (ISSUE 10, default on; flag
+    ``serving_paged``) — instead of a dense per-slot ``max_len``
+    stripe, K/V live in a SHARED ``[num_blocks, n_layer, n_head,
+    block_size, dk]`` pool addressed through per-slot block tables
+    (``serving.kvpool.BlockPool``): blocks allocate at admission /
+    as decode crosses block boundaries and free at retirement, so a
+    short request no longer reserves ``max_len`` worth of cache. A
+    radix prefix cache (``kvpool.RadixCache``) maps full-block prompt
+    prefixes to refcounted block chains — an admission whose prompt
+    shares a cached prefix SKIPS those prefill chunks entirely
+    (copy-on-write resolves the one case a shared block would be
+    written; LRU eviction of unreferenced chains bounds the cache at
+    the pool size). When the pool runs dry anyway, the LOWEST-priority
+    (latest-admitted) request is PREEMPTED: its blocks free, it
+    re-queues for re-prefill, and deterministic decode (greedy, or
+    counter-keyed seeded sampling) makes the resumed output identical
+    — exactly-once survives. Per-request ``SamplingParams``
+    (temperature / top-k / top-p / seed) execute in-step with per-slot
+    PRNG state; temperature-0 requests stay BITWISE-greedy (the
+    megastep/fleet token-identity contracts are untouched).
 
 Every engine iteration is instrumented: monitor gauges/counters
 (``ptpu_serving_*``), a ``serving_step`` flight-recorder row carrying
@@ -40,6 +60,7 @@ spans per prefill chunk, a first-token mark, step-span links) so
 """
 
 import collections
+import itertools
 import threading
 import time
 
@@ -49,6 +70,9 @@ import jax.numpy as jnp
 
 from ..monitor import runtime as _monrt
 from ..trace import runtime as _trc
+from . import kvpool as _kvpool
+from .sampling import SamplingParams, sample as _sample, \
+    step_keys as _step_keys
 
 __all__ = ["Engine", "Request", "sequential_generate"]
 
@@ -72,11 +96,20 @@ class Request:
 
     __slots__ = ("prompt", "max_new", "tokens", "score", "_event",
                  "_error", "t_enqueue", "t_admit", "t_first_token",
-                 "t_retire", "prefill_chunks", "_span", "rid")
+                 "t_retire", "prefill_chunks", "_span", "rid",
+                 "sampling", "preemptions", "_seq")
 
-    def __init__(self, prompt, max_new, request_id=None):
+    def __init__(self, prompt, max_new, request_id=None, sampling=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
+        # per-request sampling (ISSUE 10): None = bitwise-greedy (the
+        # temperature-0 default every identity pin rides on)
+        self.sampling = sampling
+        self.preemptions = 0
+        # admission priority: set once at FIRST admission and preserved
+        # across preemption, so a preempted request re-admits at its
+        # original priority instead of re-entering as "newest"
+        self._seq = None
         # durable caller-assigned id (serving.fleet router): a request
         # RE-EXECUTED on a second replica after churn carries the SAME
         # id, so its serving.request spans on both replicas share the
@@ -162,6 +195,10 @@ def _flag(name, default):
         return default
 
 
+# the per-slot sampling state a greedy (default) request activates with
+_GREEDY = SamplingParams()
+
+
 class Engine:
     """Continuous-batching engine over a KV-cached incremental decoder.
 
@@ -179,10 +216,25 @@ class Engine:
     K-1 fewer host round-trips per K tokens, at the cost of TTFT/TPOT
     stamps coarsening to megastep granularity and admissions landing
     at megastep boundaries (a pending admission forces a K→1 boundary
-    first)."""
+    first).
+
+    Paged KV (ISSUE 10; flags ``serving_paged`` /
+    ``serving_block_size`` / ``serving_kv_blocks`` /
+    ``serving_prefix_cache``): ``paged=True`` (the default) stores K/V
+    in a shared block pool with per-slot block tables, a radix prefix
+    cache over full-block prompt prefixes, copy-on-write for shared
+    blocks, and preemption (lowest-priority request re-queued for
+    re-prefill) when the pool runs dry. ``paged=False`` restores the
+    PR-5 dense ``[slots, ...]`` layout. ``num_blocks`` defaults to
+    ``slots * ceil(max_len / block_size)`` — dense-capacity parity,
+    with the savings coming from short requests and shared prefixes.
+    Greedy output is token-identical across both layouts; per-request
+    ``sampling`` (``SamplingParams``) rides either."""
 
     def __init__(self, model, slots=8, prefill_chunk=None,
-                 admission_wait=None, name="engine", megastep=None):
+                 admission_wait=None, name="engine", megastep=None,
+                 paged=None, block_size=None, num_blocks=None,
+                 prefix_cache=None):
         if slots < 1:
             raise ValueError("slots must be >= 1, got %r" % (slots,))
         self.model = model
@@ -206,20 +258,65 @@ class Engine:
         # dispatch land at the same host timestamp.
         self._megastep = max(1, int(megastep if megastep is not None
                                     else _flag("serving_megastep", 1)))
+        # paged KV (ISSUE 10): host-side block accounting; the device
+        # pool arrays live in self._state. Block tables are rebuilt as
+        # a small [slots, max_blocks] int32 array per dispatch and
+        # passed as a plain (non-donated) argument to the compiled
+        # step — the compiled SHAPE never changes as tables do.
+        self._paged = bool(paged if paged is not None
+                           else _flag("serving_paged", True))
+        if self._paged:
+            bs = int(block_size if block_size is not None
+                     else _flag("serving_block_size", 16))
+            self._block_size = max(1, min(bs, model.max_len))
+            self._max_blocks = -(-model.max_len // self._block_size)
+            nb = int(num_blocks if num_blocks is not None
+                     else _flag("serving_kv_blocks", 0))
+            if nb <= 0:
+                # capacity parity with the dense layout by default —
+                # the paged win is that SHORT requests no longer pin
+                # max_len worth of it, and shared prefixes share it
+                nb = self.slots * self._max_blocks
+            if nb < self._max_blocks:
+                raise ValueError(
+                    "num_blocks %d cannot hold one max_len request "
+                    "(%d blocks of %d positions)"
+                    % (nb, self._max_blocks, self._block_size))
+            self._pool = _kvpool.BlockPool(nb, self._block_size)
+            use_prefix = bool(
+                prefix_cache if prefix_cache is not None
+                else _flag("serving_prefix_cache", True))
+            self._prefix = (_kvpool.RadixCache(self._block_size,
+                                               self._pool)
+                            if use_prefix else None)
+        else:
+            self._pool = None
+            self._prefix = None
+        self._admit_seq = itertools.count()  # admission priority order
+        self._preempted_iter = 0
         self._cv = threading.Condition()
         self._queue = collections.deque()
         self._recs = [None] * self.slots   # loop-thread-only slot records
         self._stop = False
         self._error = None                 # loop-death cause, if any
         self._state = self._init_state()
-        self._step_fn = jax.jit(self._step_impl, donate_argnums=0)
+        # `sampled` is static (arg 2): two cached compiles — the
+        # all-greedy program (bitwise PR-5) and, only once stochastic
+        # traffic actually lands, the sampling-tail program
+        self._step_fn = jax.jit(self._step_impl, donate_argnums=0,
+                                static_argnums=2)
         self._megastep_fn = None           # built lazily (jit) at K > 1
         self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=0)
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=0)
+        self._release_fn = None            # built lazily (preemption)
+        self._copy_fn = None               # built lazily (COW)
         self.stats = {"steps": 0, "decode_steps": 0, "tokens": 0,
                       "admissions": 0, "retirements": 0,
                       "active_slot_steps": 0, "prefill_chunks": 0,
-                      "megastep_dispatches": 0}
+                      "megastep_dispatches": 0, "prefix_hits": 0,
+                      "prefix_misses": 0, "prefix_hit_tokens": 0,
+                      "prefix_evictions": 0, "preemptions": 0,
+                      "cow_copies": 0, "kv_peak_blocks": 0}
         # optional completion hook (serving.fleet's ReplicaServer):
         # called with each Request AFTER its future resolves — retired
         # or failed — so an RPC front can deliver results event-driven
@@ -231,17 +328,25 @@ class Engine:
         self._thread.start()
 
     # -- public API --------------------------------------------------------
-    def warmup(self):
-        """Compile every decode dispatch path up front: the single
-        step and, with ``megastep`` > 1, the fused K-step. One decode
-        over the ALL-INACTIVE slot state is semantically a no-op — the
-        active mask gates every cache write and every sampling-state
-        update — so this pays only the compiles. Call before
-        submitting traffic (the scheduler loop never touches decode
-        state while the queue and slots are empty). Without it a
-        megastep engine compiles the single-step path lazily on its
-        first mid-flight admission, stalling that iteration by a full
-        XLA compile."""
+    def warmup(self, sampled=False):
+        """Compile the GREEDY decode dispatch paths up front: the
+        single step (paged or dense) and, with ``megastep`` > 1, the
+        fused K-step twin. One decode over the ALL-INACTIVE slot state
+        is semantically a no-op — the active mask gates every cache
+        write (paged writes of masked rows drop out of bounds) and
+        every sampling-state update — so this pays only the compiles.
+        Call before submitting traffic (the scheduler loop never
+        touches decode state while the queue and slots are empty).
+        Without it a megastep engine compiles the single-step path
+        lazily on its first mid-flight admission, stalling that
+        iteration by a full XLA compile — and a PAGED K>1 engine
+        previously compiled both paged paths mid-traffic (the
+        PR-7-measured 660 ms stall). ``sampled=True`` additionally
+        pre-compiles the sampling-tail variants — pass it when the
+        workload will carry ``SamplingParams``, otherwise the first
+        stochastic request eats those compiles mid-traffic (the
+        greedy-only default keeps greedy benches from paying for
+        programs they never dispatch)."""
         # the whole body holds _cv: a submit() racing in after the
         # guard would otherwise let the loop thread activate a slot in
         # self._state concurrently with warmup donating it (_step_fn
@@ -254,23 +359,31 @@ class Engine:
                     "warmup() must run before traffic is submitted "
                     "(the scheduler loop owns the decode state once a "
                     "request is in flight)")
-            state, _, _ = self._step_fn(self._state)
-            if self._megastep > 1:
-                if self._megastep_fn is None:
-                    self._megastep_fn = jax.jit(self._megastep_impl,
-                                                donate_argnums=0)
-                state, _, _ = self._megastep_fn(state)
+            btab = self._btab_all()
+            variants = (False, True) if sampled else (False,)
+            state = self._state
+            for v in variants:
+                state, _, _ = self._step_fn(state, btab, v)
+                if self._megastep > 1:
+                    if self._megastep_fn is None:
+                        self._megastep_fn = jax.jit(
+                            self._megastep_impl, donate_argnums=0,
+                            static_argnums=2)
+                    state, _, _ = self._megastep_fn(state, btab, v)
             self._state = state
         return self
 
-    def submit(self, prompt, max_new_tokens, request_id=None):
+    def submit(self, prompt, max_new_tokens, request_id=None,
+               sampling=None):
         """Enqueue one request; returns its Request handle. ``prompt``
         is the token-id prefix (≥ 1 token — pass ``[model.bos_id]`` for
         unconditional generation). ``request_id``: optional durable id
         (the fleet router's exactly-once key) stamped on the handle and
         its trace span — admission itself never dedups; the fleet tier
         (ReplicaServer journal) is where resubmitted ids are made
-        idempotent BEFORE they reach the engine."""
+        idempotent BEFORE they reach the engine. ``sampling``: a
+        ``SamplingParams`` (or its dict form, the fleet wire shape);
+        None / temperature 0 = bitwise-greedy."""
         prompt = [int(t) for t in (prompt or [self.model.bos_id])]
         max_new = int(max_new_tokens)
         if max_new < 1:
@@ -283,6 +396,15 @@ class Engine:
             raise ValueError(
                 "prompt len %d + max_new %d exceeds model max_len %d"
                 % (len(prompt), max_new, self.model.max_len))
+        # validate BEFORE the handle exists (same ValueError surface as
+        # the bounds above, so the fleet's BADR typed-reject covers it)
+        sp = (SamplingParams.from_dict(sampling)
+              if sampling is not None else None)
+        if sp is not None and sp.greedy:
+            # temperature 0 is argmax no matter what top_k/top_p/seed
+            # say — fold to the default so a temp-0 request never
+            # forces co-scheduled traffic onto the sampled program
+            sp = None
         with self._cv:
             if self._stop:
                 err = getattr(self, "_error", None)
@@ -292,7 +414,8 @@ class Engine:
                 raise RuntimeError("engine is closed")
             # construct after the closed-check: a rejected submit must
             # not open a request span nobody will ever finish
-            req = Request(prompt, max_new, request_id=request_id)
+            req = Request(prompt, max_new, request_id=request_id,
+                          sampling=sp)
             self._queue.append(req)
             self._cv.notify_all()
         return req
@@ -338,24 +461,58 @@ class Engine:
 
     # -- compiled pieces ---------------------------------------------------
     def _init_state(self):
-        s = self.model._init_state(self.slots)
+        if self._paged:
+            s = self.model._init_paged_state(self._pool.num_blocks,
+                                             self._block_size)
+        else:
+            s = self.model._init_state(self.slots)
         z = lambda dt: jnp.zeros((self.slots,), dt)
         s["tok"], s["pos"], s["count"] = z(jnp.int32), z(jnp.int32), \
             z(jnp.int32)
         s["active"] = z(bool)
         s["score"] = z(jnp.float32)
         s["max_new"] = jnp.ones((self.slots,), jnp.int32)
+        # per-slot sampling state (ISSUE 10): zeros = bitwise-greedy
+        s["temp"] = z(jnp.float32)
+        s["topk"] = z(jnp.int32)
+        s["topp"] = jnp.ones((self.slots,), jnp.float32)
+        s["seed"] = z(jnp.uint32)
         return s
 
-    def _step_impl(self, state):
-        """One decode iteration over all slots: greedy-sample every
-        active slot, advance its cache position, flag retirements."""
+    def _step_impl(self, state, btab, sampled=False):
+        """One decode iteration over all slots: sample every active
+        slot (argmax for temperature-0 slots — the bitwise-greedy
+        default — a per-slot counter-keyed draw otherwise), advance
+        its cache position, flag retirements. ``btab`` is the
+        [slots, max_blocks] block-table array in paged mode, None in
+        dense mode (the PR-5 layout). ``sampled`` is STATIC (a
+        separate compile per value): the host dispatches the sampled
+        program only while a stochastic request is live, so the
+        all-greedy hot path never pays the per-slot PRNG + two vocab
+        sorts (measured ~0.33 ms/step on this CPU — ~2.7x the whole
+        greedy step) and stays instruction-for-instruction the PR-5
+        program."""
         state = dict(state)
         tok, pos, active = state["tok"], state["pos"], state["active"]
-        logits, state = self.model._step_logits_slots(
-            tok, state, pos, write_mask=active)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        if self._paged:
+            logits, state = self.model._step_logits_paged(
+                tok, state, pos, btab, write_mask=active)
+        else:
+            logits, state = self.model._step_logits_slots(
+                tok, state, pos, write_mask=active)
+        logits32 = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits32)
+        greedy = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        if sampled:
+            # per-slot draw, SELECTED per slot: temperature-0 slots
+            # take the greedy value through an elementwise where, so
+            # their tokens are bitwise the greedy program's
+            keys = _step_keys(state["seed"], state["count"])
+            drawn = _sample(logits32, state["temp"], state["topk"],
+                            state["topp"], keys)
+            nxt = jnp.where(state["temp"] > 0.0, drawn, greedy)
+        else:
+            nxt = greedy
         tok_logp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
         end = jnp.int32(self.model.end_id)
         emit = jnp.where(active, nxt, end)
@@ -368,27 +525,34 @@ class Engine:
         state["active"] = active & ~fin
         return state, emit, fin
 
-    def _megastep_impl(self, state):
+    def _megastep_impl(self, state, btab, sampled=False):
         """K decode iterations fused into one device program: a
         lax.scan over ``_step_impl``, streaming each sub-iteration's
         (emit, fin) rows out as ``[K, S]`` stacks. A slot that retires
         at sub-iteration j goes inactive in the carry, so later
         sub-iterations emit end_id for it and write nothing — the host
         loop skips those rows, keeping output token-identical to K
-        single steps."""
+        single steps. In paged mode the host pre-allocates blocks for
+        all K write positions, so one table serves the whole fused
+        dispatch. ``sampled`` is static, like ``_step_impl``'s."""
         def body(st, _):
-            st, emit, fin = self._step_impl(st)
+            st, emit, fin = self._step_impl(st, btab, sampled)
             return st, (emit, fin)
 
         state, (emits, fins) = jax.lax.scan(
             body, dict(state), None, length=self._megastep)
         return state, emits, fins
 
-    def _prefill_impl(self, state, slot, toks, start, n_valid):
+    def _prefill_impl(self, state, slot, toks, start, n_valid,
+                      btab_row):
+        if self._paged:
+            return self.model._prefill_chunk_paged(
+                dict(state), toks, start, n_valid, btab_row)
         return self.model._prefill_chunk_slot(
             dict(state), slot, toks, start, n_valid)
 
-    def _activate_impl(self, state, slot, tok, pos, max_new):
+    def _activate_impl(self, state, slot, tok, pos, max_new, temp,
+                       topk, topp, seed):
         state = dict(state)
         at = lambda n, v: state[n].at[slot].set(v)
         state["tok"] = at("tok", tok)
@@ -397,7 +561,165 @@ class Engine:
         state["score"] = at("score", 0.0)
         state["count"] = at("count", 0)
         state["max_new"] = at("max_new", max_new)
+        state["temp"] = at("temp", temp)
+        state["topk"] = at("topk", topk)
+        state["topp"] = at("topp", topp)
+        state["seed"] = at("seed", seed)
         return state
+
+    def _release_impl(self, state, slot):
+        """Deactivate one slot (preemption): the write mask goes False
+        so the slot's stale tok/pos can never write again; everything
+        else resets at re-activation."""
+        state = dict(state)
+        state["active"] = state["active"].at[slot].set(False)
+        return state
+
+    def _copy_impl(self, state, src, dst):
+        """Copy-on-write: duplicate one physical block's K/V (every
+        layer) so a request whose FULLY block-aligned prompt matched
+        the cache can write its first decode position privately."""
+        state = dict(state)
+        for name in ("pool_k", "pool_v"):
+            a = state[name]
+            state[name] = a.at[dst].set(a[src])
+        return state
+
+    # -- paged-KV host accounting (loop thread only) -----------------------
+    def _btab_all(self):
+        """The [slots, max_blocks] int32 block-table array the compiled
+        step gathers through (dense mode: None). Unassigned entries
+        read block 0, masked by the causal bias."""
+        if not self._paged:
+            return None
+        arr = np.zeros((self.slots, self._max_blocks), np.int32)
+        for s, rec in enumerate(self._recs):
+            if rec is not None:
+                t = rec["table"]
+                arr[s, :len(t)] = t
+        return arr
+
+    def _btab_row(self, rec):
+        row = np.zeros((self._max_blocks,), np.int32)
+        t = rec["table"]
+        row[:len(t)] = t
+        return row
+
+    def _ensure_blocks(self, rec, last_pos):
+        """Grow ``rec``'s block table to cover cache position
+        ``last_pos``, walking the pressure ladder on a dry pool:
+        prefix-cache LRU eviction first, then PREEMPTION of the
+        lowest-priority (latest-admitted) request. Returns False when
+        ``rec`` itself was the preemption victim (the caller must stop
+        touching it — its slot record is gone)."""
+        last_pos = min(int(last_pos), self.model.max_len - 1)
+        need = last_pos // self._block_size + 1 - len(rec["table"])
+        for _ in range(need):
+            b = self._alloc_one(rec)
+            if b is None:
+                return False
+            rec["table"].append(b)
+            rec["refs"].append(b)
+        return True
+
+    def _alloc_one(self, rec):
+        """One block for ``rec``, or None when ``rec`` was preempted to
+        make room (self-preemption: the pool cannot serve it without
+        taking blocks from strictly HIGHER-priority — earlier-admitted
+        — requests, so ``rec`` yields instead; with admission
+        priorities preserved across preemption this cannot ping-pong,
+        the oldest request always keeps its blocks and finishes)."""
+        while True:
+            got = self._pool.alloc(1)
+            if got is not None:
+                return got[0]
+            if self._prefix is not None:
+                freed = self._prefix.evict(1)
+                if freed:
+                    self.stats["prefix_evictions"] += freed
+                    _monrt.on_prefix_evictions(freed)
+                    continue
+            victim = self._pick_victim()
+            if victim is None or victim["seq"] <= rec["seq"]:
+                # nobody holds blocks, or every holder outranks rec
+                # (rec included: victim is rec covers itself here) —
+                # rec yields rather than evicting head-of-line work
+                self._preempt(rec)
+                return None
+            self._preempt(victim)
+
+    def _pick_victim(self):
+        """Lowest-priority slot record = the latest-admitted (highest
+        admission sequence) AMONG records that actually hold blocks:
+        FIFO traffic keeps its head-of-line work running and pushes
+        the tail back to the queue. A zero-block record (admitted,
+        lazy allocation not yet run) cannot relieve pool pressure —
+        preempting it would churn the request and inflate the
+        preemption telemetry for nothing."""
+        victim = None
+        for r in self._recs:
+            if r is not None and r["refs"] and (
+                    victim is None or r["seq"] > victim["seq"]):
+                victim = r
+        return victim
+
+    def _preempt(self, rec):
+        """Free a record's blocks and RE-QUEUE its request (front of
+        the queue — it keeps its priority) for re-prefill. Output
+        stays identical on resume: greedy decode is deterministic and
+        sampled decode draws through fold_in(seed, tokens_generated),
+        which restarts with the request — so the caller-visible result
+        (and the fleet's exactly-once dedup) cannot tell a preempted
+        request from an undisturbed one. The partial tokens are
+        discarded; TTFT keeps the FIRST first-token stamp (the user
+        saw nothing either way, and a preemption must not flatter
+        it)."""
+        slot = next(s for s, r in enumerate(self._recs) if r is rec)
+        req = rec["req"]
+        self._release_blocks(rec)
+        self._recs[slot] = None
+        if rec["live"]:
+            if self._release_fn is None:
+                self._release_fn = jax.jit(self._release_impl,
+                                           donate_argnums=0)
+            self._state = self._release_fn(self._state, np.int32(slot))
+        del req.tokens[:]
+        req.score = None
+        req.preemptions += 1
+        req._span.annotate(preemptions=req.preemptions)
+        self.stats["preemptions"] += 1
+        self._preempted_iter += 1
+        with self._cv:
+            self._queue.appendleft(req)
+
+    def _cow(self, rec, bi):
+        """Copy-on-write of shared block ``bi`` in ``rec``'s table (the
+        fully-block-aligned-prompt case: activation must write the
+        last prompt position into a block the prefix cache shares).
+        Returns False when the allocation preempted ``rec``."""
+        new = self._alloc_one(rec)
+        if new is None:
+            return False
+        old = rec["table"][bi]
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(self._copy_impl, donate_argnums=0)
+        self._state = self._copy_fn(self._state, np.int32(old),
+                                    np.int32(new))
+        rec["table"][bi] = new
+        rec["refs"][rec["refs"].index(old)] = new
+        self._pool.free(old)           # drop the reader ref on the
+        rec["shared"] = bi             # shared copy; cache keeps its own
+        self.stats["cow_copies"] += 1
+        return True
+
+    def _release_blocks(self, rec):
+        """Drop every pool ref the record holds (own allocations AND
+        matched prefix-cache readers — the refcount protocol makes the
+        two indistinguishable here)."""
+        for b in rec["refs"]:
+            self._pool.free(b)
+        rec["refs"] = []
+        rec["table"] = []
 
     # -- scheduler loop ----------------------------------------------------
     def _loop(self):
@@ -438,6 +760,7 @@ class Engine:
         fused K-step megastep when no admissions/prefills pend) over
         the active batch."""
         finished = ()
+        self._preempted_iter = 0
         try:
             with _trc.span("engine.step") as sp:
                 admitted = self._admit()
@@ -471,11 +794,21 @@ class Engine:
                             k=steps_run,
                             **({"megastep_dt": dt} if trips > 1
                                else {}))
+                kv = {}
+                if self._paged:
+                    used = self._pool.used
+                    self.stats["kv_peak_blocks"] = max(
+                        self.stats["kv_peak_blocks"], used)
+                    kv = {"kv_used": used,
+                          "kv_total": self._pool.num_blocks,
+                          "prefix_hits": self.stats["prefix_hits"],
+                          "prefix_misses": self.stats["prefix_misses"],
+                          "preempted": self._preempted_iter}
                 _monrt.on_serving_step(
                     active=active, slots=self.slots, queue_depth=depth,
                     emitted=emitted, admitted=admitted,
                     retired=len(finished), engine=self.name, dt=dt,
-                    k=steps_run, dispatched=trips)
+                    k=steps_run, dispatched=trips, **kv)
                 for req, _ in finished:
                     self._retire_telemetry(req)
         finally:
@@ -564,16 +897,56 @@ class Engine:
                     req._span.annotate(slot=slot,
                                        queue_wait=req.queue_wait,
                                        admit_step=self._step_span_id())
-                    self._recs[slot] = {"req": req,
-                                        "cursor": 0, "live": False}
+                    if req._seq is None:      # re-admission after a
+                        req._seq = next(self._admit_seq)  # preemption
+                    rec = {"req": req, "cursor": 0, "live": False,
+                           "seq": req._seq}   # keeps its priority
+                    if self._paged:
+                        self._admit_paged(rec)
+                    self._recs[slot] = rec
                     admitted += 1
         return admitted
+
+    def _admit_paged(self, rec):
+        """Paged admission: look the prompt up in the radix prefix
+        cache. A hit hands the record a refcounted chain of shared
+        blocks holding the prefix's K/V, and the prefill cursor jumps
+        past them — those chunks are never executed (the measured
+        prefill-compute saving for shared-system-prompt traffic).
+        Own-block allocation stays lazy (prefill/decode time): an
+        admission allocates nothing it has not reached yet."""
+        req = rec["req"]
+        rec["table"], rec["refs"] = [], []
+        rec["shared"] = 0
+        rec["inserted"] = False
+        rec["next_pos"] = None
+        if self._prefix is None:
+            return
+        blocks, ntok = self._prefix.match(req.prompt)
+        hit = bool(blocks)
+        self.stats["prefix_hits" if hit else "prefix_misses"] += 1
+        _monrt.on_prefix_lookup(hit)
+        if not hit:
+            return
+        rec["table"] = list(blocks)
+        rec["refs"] = list(blocks)
+        rec["shared"] = len(blocks)
+        # the teacher-forced prefill covers positions 0..P-2; a chain
+        # covering the WHOLE block-aligned prompt leaves cursor at
+        # need, and activation copy-on-writes the last shared block
+        rec["cursor"] = min(ntok, len(req.prompt) - 1)
+        self.stats["prefix_hit_tokens"] += rec["cursor"]
+        req._span.annotate(prefix_hit_tokens=rec["cursor"])
 
     def _advance_prefills(self):
         """One prompt chunk per prefilling slot per iteration — long
         prompts interleave with the running batch instead of stalling
         it. A slot whose prefix is fully written activates (its LAST
-        prompt token seeds the first decode step)."""
+        prompt token seeds the first decode step). Paged mode grows
+        the slot's block table just ahead of the chunk's write
+        positions (possibly evicting prefix chains / preempting), and
+        a prefix-cache hit enters here with its cursor already past
+        the cached positions."""
         for slot, rec in enumerate(self._recs):
             if rec is None or rec["live"]:
                 continue
@@ -582,6 +955,9 @@ class Engine:
             cur = rec["cursor"]
             if cur < need:
                 toks = req.prompt[cur:min(cur + self._chunk, need)]
+                if self._paged and not self._ensure_blocks(
+                        rec, cur + len(toks) - 1):
+                    continue               # rec preempted back to queue
                 chunk = np.zeros((self._chunk,), np.int32)
                 chunk[:len(toks)] = toks
                 with _trc.child_span(
@@ -590,36 +966,78 @@ class Engine:
                         step_span=self._step_span_id()):
                     self._state = self._prefill_fn(
                         self._state, np.int32(slot), chunk,
-                        np.int32(cur), np.int32(len(toks)))
+                        np.int32(cur), np.int32(len(toks)),
+                        self._btab_row(rec) if self._paged else None)
                 rec["cursor"] = cur + len(toks)
                 req.prefill_chunks += 1
                 self.stats["prefill_chunks"] += 1
             if rec["cursor"] >= need:
+                if self._paged:
+                    # the first decode step writes position `need`
+                    if not self._ensure_blocks(rec, need):
+                        continue
+                    bi = need // self._block_size
+                    if bi < rec["shared"] and not self._cow(rec, bi):
+                        continue
+                    rec["next_pos"] = need
+                sp = req.sampling or _GREEDY
                 self._state = self._activate_fn(
                     self._state, np.int32(slot),
                     np.int32(req.prompt[-1]), np.int32(need),
-                    np.int32(req.max_new))
+                    np.int32(req.max_new),
+                    np.float32(sp.temperature), np.int32(sp.top_k),
+                    np.float32(sp.top_p), np.uint32(sp.seed))
                 rec["live"] = True
 
     def _decode(self, k=1):
         """One decode dispatch over the active batch: a single step
         (k=1, the PR-5 path), or a fused K-step megastep — ONE device
-        program, one emit/fin fetch, K logical steps. Returns
-        (slots active at dispatch, finished, steps run, tokens
-        emitted)."""
+        program, one emit/fin fetch, K logical steps. Paged mode first
+        grows every live slot's block table to cover its next k write
+        positions (one table serves the whole fused dispatch; the
+        pressure ladder may preempt here). Returns (slots active at
+        dispatch, finished, steps run, tokens emitted)."""
+        if self._paged:
+            for slot in range(self.slots):
+                # re-read per iteration: an earlier slot's allocation
+                # may have PREEMPTED this one — allocating for its
+                # stale record would leak the blocks it appends
+                rec = self._recs[slot]
+                if rec is not None and rec["live"]:
+                    # cover only the write positions this slot can
+                    # actually consume: a request with 1 token left
+                    # must not trigger the pressure ladder (evicting
+                    # chains / preempting a peer) for K-1 positions
+                    # its retirement will never write
+                    rem = max(1, rec["req"].max_new
+                              - len(rec["req"].tokens))
+                    # a False return means rec was preempted — its
+                    # slot record is already gone from _recs
+                    self._ensure_blocks(
+                        rec, rec["next_pos"] + min(k, rem) - 1)
         live = [s for s, r in enumerate(self._recs)
                 if r is not None and r["live"]]
         if not live:
             return 0, [], 0, 0, 0
+        btab = self._btab_all()
+        # dispatch the sampling-tail program only while a stochastic
+        # request is actually live (static per-variant compile): the
+        # all-greedy path stays the PR-5 program, bit for bit and
+        # cost for cost
+        sampled = any(
+            self._recs[s]["req"].sampling is not None for s in live)
         if k > 1:
             if self._megastep_fn is None:
                 self._megastep_fn = jax.jit(self._megastep_impl,
-                                            donate_argnums=0)
-            self._state, emits, fins = self._megastep_fn(self._state)
+                                            donate_argnums=0,
+                                            static_argnums=2)
+            self._state, emits, fins = self._megastep_fn(
+                self._state, btab, sampled)
             self.stats["megastep_dispatches"] += 1
             emits, fins = np.asarray(emits), np.asarray(fins)
         else:
-            self._state, emit, fin = self._step_fn(self._state)
+            self._state, emit, fin = self._step_fn(self._state, btab,
+                                                   sampled)
             # host-side axis add: [None] on the DEVICE array would
             # dispatch a reshape per step on the k=1 hot path
             emits = np.asarray(emit)[None]
@@ -644,6 +1062,26 @@ class Engine:
                 req = rec["req"]
                 req.tokens.append(int(emits[j, slot]))
                 emitted += 1
+                if self._paged:
+                    rec["next_pos"] += 1   # mirrors the device pos
+                if self._paged and self._prefix is not None \
+                        and not rec["inserted"]:
+                    # the slot's first decode emit wrote position P-1,
+                    # so every full prompt block is complete — publish
+                    # the chain (refcounted; the request keeps its own
+                    # refs) so later admissions sharing the prefix
+                    # skip its prefill outright. Keyed on the RECORD
+                    # (fresh each admission), not t_first_token: a
+                    # request preempted after its first token but
+                    # before publishing must still publish on resume;
+                    # re-publishing an already-cached chain dedups to
+                    # a no-op
+                    rec["inserted"] = True
+                    bs = self._block_size
+                    nfull = len(req.prompt) // bs
+                    if nfull:
+                        self._prefix.insert(req.prompt[:nfull * bs],
+                                            rec["table"][:nfull])
                 if req.t_first_token is None:
                     req.t_first_token = now
                     try:
@@ -667,6 +1105,11 @@ class Engine:
                         # score is frozen by its inactive mask
                         scores = np.asarray(self._state["score"])
                     finished.append((req, float(scores[slot])))
+                    if self._paged:
+                        # retirement frees the request's pool refs;
+                        # prefix-published blocks survive on the
+                        # cache's own refs (evictable once cold)
+                        self._release_blocks(rec)
                     self._recs[slot] = None
                     live.remove(slot)
         self.stats["tokens"] += emitted
@@ -678,10 +1121,14 @@ class Engine:
 
     def _fail_all(self, err):
         with self._cv:
-            pending = [r["req"] for r in self._recs if r is not None]
+            slotted = [r for r in self._recs if r is not None]
+            pending = [r["req"] for r in slotted]
             pending += list(self._queue)
             self._queue.clear()
             self._recs = [None] * self.slots
+        if self._paged:
+            for rec in slotted:        # pool accounting stays clean
+                self._release_blocks(rec)
         cb = self.on_retire
         for req in pending:
             # failed requests still retire for attribution purposes:

@@ -26,8 +26,14 @@ Verbs (length-prefixed frames, same wire as the pserver/master/KV
 tiers — an armed fault plan, tracer, or retry policy hooks them with
 zero new plumbing):
 
-    SUBM  name=<rid>  {prompt, max_new}   admit once (journal dedups a
-                                          retried/duplicated id)
+    SUBM  name=<rid>  {prompt, max_new,   admit once (journal dedups a
+                       sampling?}         retried/duplicated id);
+                                          sampling = SamplingParams
+                                          dict (ISSUE 10) — carried in
+                                          the journal, so resubmission
+                                          re-executes with the SAME
+                                          temperature/top-k/top-p/seed
+                                          and stays deterministic
     POLL  {wait, max}                     long-poll finished-but-unacked
                                           results (at-least-once
                                           delivery; re-polled until
@@ -281,7 +287,8 @@ class ReplicaServer:
                     try:
                         req = self.engine.submit(
                             body["prompt"], body["max_new"],
-                            request_id=name)
+                            request_id=name,
+                            sampling=body.get("sampling"))
                     except ValueError as e:
                         # invalid request (e.g. prompt + max_new past
                         # the model's max_len): a typed reply — NOT a
@@ -456,11 +463,13 @@ class ReplicaClient:
             attempt, what=what, retry_on=RETRYABLE,
             on_retry=lambda a, e: self._drop_conn())
 
-    def submit(self, rid, prompt, max_new):
+    def submit(self, rid, prompt, max_new, sampling=None):
         def body():
-            _send_msg(self._sock, "SUBM", rid, json.dumps(
-                {"prompt": [int(t) for t in prompt],
-                 "max_new": int(max_new)}).encode())
+            wire = {"prompt": [int(t) for t in prompt],
+                    "max_new": int(max_new)}
+            if sampling is not None:
+                wire["sampling"] = sampling
+            _send_msg(self._sock, "SUBM", rid, json.dumps(wire).encode())
             op, _, payload = _recv_msg(self._sock)
             if op == "BADR":
                 # typed rejection: not retryable — the request itself
@@ -524,15 +533,17 @@ class FleetRequest:
     once, or the request fails terminally (Overloaded is raised at
     submit time instead — shed requests never get a handle)."""
 
-    __slots__ = ("rid", "prompt", "max_new", "session", "tokens",
-                 "score", "resubmits", "t_submit", "t_done", "_event",
-                 "_error")
+    __slots__ = ("rid", "prompt", "max_new", "session", "sampling",
+                 "tokens", "score", "resubmits", "t_submit", "t_done",
+                 "_event", "_error")
 
-    def __init__(self, rid, prompt, max_new, session=None):
+    def __init__(self, rid, prompt, max_new, session=None,
+                 sampling=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.session = session
+        self.sampling = sampling
         self.tokens = None
         self.score = None
         self.resubmits = 0
@@ -656,13 +667,21 @@ class Router:
             t.start()
 
     # -- public API --------------------------------------------------------
-    def submit(self, prompt, max_new_tokens, session=None):
+    def submit(self, prompt, max_new_tokens, session=None,
+               sampling=None):
         """Accept one request (returns its FleetRequest handle), or
         fast-fail with the typed ``Overloaded`` error once the global
         queue bound is hit — shed requests are counted against the SLO
-        error budget and never journaled."""
+        error budget and never journaled. ``sampling``: per-request
+        ``SamplingParams`` (or its dict form); journaled with the
+        request, so an at-least-once re-dispatch to a survivor replica
+        re-executes with the SAME params + seed — deterministic
+        counter-keyed sampling keeps the exactly-once dedup valid for
+        stochastic traffic too."""
         prompt = [int(t) for t in prompt]
         max_new = int(max_new_tokens)
+        if sampling is not None and not isinstance(sampling, dict):
+            sampling = sampling.to_dict()      # SamplingParams → wire
         with self._cv:
             if self._closed:
                 raise RuntimeError("router is closed")
@@ -684,10 +703,12 @@ class Router:
                 self._submits_since_sweep = 0
                 self._sweep_journal_locked()
             rid = "%s-%06d" % (self._id, next(self._seq))
-            handle = FleetRequest(rid, prompt, max_new, session=session)
+            handle = FleetRequest(rid, prompt, max_new, session=session,
+                                  sampling=sampling)
             self._journal[rid] = {
                 "rid": rid, "prompt": prompt, "max_new": max_new,
-                "session": session, "state": _QUEUED, "replica": None,
+                "session": session, "sampling": sampling,
+                "state": _QUEUED, "replica": None,
                 "attempts": 0, "handle": handle,
             }
             self._queue.append(rid)
@@ -697,13 +718,15 @@ class Router:
         return handle
 
     def generate_many(self, prompts, max_new_tokens, session=None,
-                      timeout=300.0):
+                      sampling=None, timeout=300.0):
         """Synchronous convenience mirroring Engine.generate_many:
-        submit every prompt, block for all results in input order."""
+        submit every prompt, block for all results in input order.
+        ``sampling`` applies to every prompt (one params dict)."""
         n = len(prompts)
         if not hasattr(max_new_tokens, "__len__"):
             max_new_tokens = [max_new_tokens] * n
-        handles = [self.submit(p, m, session=session)
+        handles = [self.submit(p, m, session=session,
+                               sampling=sampling)
                    for p, m in zip(prompts, max_new_tokens)]
         return [h.result(timeout=timeout) for h in handles]
 
@@ -964,7 +987,8 @@ class Router:
                                  endpoint=info["endpoint"],
                                  attempt=entry["attempts"]):
                     info["client"].submit(rid, entry["prompt"],
-                                          entry["max_new"])
+                                          entry["max_new"],
+                                          entry.get("sampling"))
             except RETRYABLE:
                 self._replica_down(slot, info["endpoint"], "dispatch")
             except Exception as e:

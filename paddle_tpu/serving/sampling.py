@@ -1,0 +1,140 @@
+"""Per-request sampling: temperature / top-k / top-p with per-slot
+PRNG state, executed INSIDE the compiled slot-batched decode step.
+
+The engine's sampling contract (pinned in tests/test_kvpool.py and by
+every existing greedy-identity test):
+
+  * ``temperature == 0`` (the default) is BITWISE-greedy: the argmax
+    path is computed exactly as the PR-5 engine computed it and
+    selected per slot with an elementwise ``where`` — so every
+    token-identity pin (engine vs ``sequential_generate``, megastep
+    fusion, fleet exactly-once re-execution) survives unchanged.
+  * stochastic slots draw through a counter-based per-slot key:
+    ``fold_in(PRNGKey(seed), tokens_generated_so_far)``. No entropy
+    enters the step, which buys three properties at once — the same
+    ``seed`` reproduces the same tokens, a fused K-step megastep draws
+    the same sequence as K single steps (the count rides the scan
+    carry), and a PREEMPTED request re-decoded from its prompt
+    regenerates its exact output (the count restarts with it), keeping
+    the fleet's exactly-once dedup valid for sampled traffic.
+  * ``top_k`` masks to the k highest logits (ties at the k-th logit
+    are all kept); ``top_p`` masks to the smallest cumulative-p head
+    of the top-k-filtered distribution (the top-1 token is always
+    kept). Both run as fixed-shape sorts so the compiled step never
+    re-traces as per-request parameters vary.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingParams", "sample", "step_keys"]
+
+
+class SamplingParams:
+    """Validated per-request sampling knobs, wire-serializable (the
+    fleet's SUBM frames carry ``to_dict()``; resubmission to a survivor
+    replica re-executes with the SAME params + seed, so sampled
+    requests stay deterministic under churn).
+
+    temperature: 0 = greedy (bitwise; the default). > 0 scales logits.
+    top_k:       0 = off; else sample among the k highest logits.
+    top_p:       1.0 = off; else nucleus sampling inside top-k.
+    seed:        per-request PRNG seed (default 0 — reproducibility,
+                 not entropy, is the contract; pass your own for
+                 independent streams)."""
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature=0.0, top_k=0, top_p=1.0, seed=0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0, got %r"
+                             % (temperature,))
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0, got %r" % (top_k,))
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError("top_p must be in (0, 1], got %r"
+                             % (top_p,))
+        if not (0 <= self.seed < 2 ** 32):
+            raise ValueError("seed must fit uint32, got %r" % (seed,))
+
+    @property
+    def greedy(self):
+        return self.temperature <= 0.0
+
+    def to_dict(self):
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
+
+    @classmethod
+    def from_dict(cls, d):
+        if d is None:
+            return cls()
+        if isinstance(d, cls):
+            return d
+        if not isinstance(d, dict):
+            # ValueError, not AttributeError: Engine.submit promises
+            # the fleet's BADR typed-reject covers invalid sampling —
+            # a non-dict wire payload must not tear the connection and
+            # get retried into every replica as a transport failure
+            raise ValueError(
+                "sampling must be a SamplingParams or its dict form, "
+                "got %r" % (type(d).__name__,))
+        unknown = set(d) - {"temperature", "top_k", "top_p", "seed"}
+        if unknown:
+            # a misspelled knob ("temp", "topK") must not silently run
+            # greedy — the caller asked for sampling and would get
+            # deterministic unsampled output with no error anywhere
+            raise ValueError(
+                "unknown sampling field(s) %s (known: temperature, "
+                "top_k, top_p, seed)" % sorted(unknown))
+        return cls(temperature=d.get("temperature", 0.0),
+                   top_k=d.get("top_k", 0),
+                   top_p=d.get("top_p", 1.0),
+                   seed=d.get("seed", 0))
+
+    def __repr__(self):
+        return ("SamplingParams(temperature=%g, top_k=%d, top_p=%g, "
+                "seed=%d)" % (self.temperature, self.top_k, self.top_p,
+                              self.seed))
+
+
+def step_keys(seeds, counts):
+    """Per-slot PRNG keys for one decode step: ``seeds`` [S] uint32,
+    ``counts`` [S] int32 (tokens generated so far). Counter-based so a
+    restart (preemption re-prefill) regenerates the same stream."""
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+    )(seeds, counts)
+
+
+def sample(logits, temperature, top_k, top_p, keys):
+    """Draw one token per slot: ``logits`` [S, V] float32,
+    ``temperature`` [S] (rows <= 0 are computed at temperature 1 and
+    DISCARDED by the caller's greedy ``where`` — never select them
+    from here), ``top_k`` [S] int32 (0 = off), ``top_p`` [S] (1 = off),
+    ``keys`` [S] PRNG keys. Returns int32 [S] token ids."""
+    v = logits.shape[-1]
+    t = jnp.where(temperature > 0.0, temperature, 1.0)
+    scaled = logits / t[:, None]
+    # top-k: keep scores >= the k-th largest (ties at the boundary all
+    # kept — fixed-shape, no dynamic gather)
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v)
+    kth = jnp.take_along_axis(srt, (k - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    # top-p over the top-k-filtered distribution: keep the smallest
+    # prefix of descending-prob tokens whose cumulative mass BEFORE
+    # each token is < p (top-1 always kept)
+    lp = jax.nn.log_softmax(masked, axis=-1)
+    probs = jnp.exp(lp)
+    ps = jnp.sort(probs, axis=-1)[:, ::-1]
+    csum = jnp.cumsum(ps, axis=-1)
+    keep = (csum - ps) < top_p[:, None]
+    minkeep = jnp.min(jnp.where(keep, ps, jnp.inf), axis=-1)
+    final = jnp.where(probs >= minkeep[:, None], lp, -jnp.inf)
+    return jax.vmap(jax.random.categorical)(keys, final).astype(
+        jnp.int32)

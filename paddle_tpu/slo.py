@@ -27,9 +27,16 @@ Spec schema (JSON)::
         {"metric": "tpot",        "percentile": 0.99, "max_seconds": 0.05},
         {"metric": "queue_wait",  "percentile": 0.95, "max_seconds": 0.25},
         {"metric": "step_latency","percentile": 0.95, "max_seconds": 0.1},
+        {"metric": "kv_used_blocks", "max_value": 56},
         {"metric": "error_rate",  "max_ratio": 0.001}
       ]
     }
+
+``kv_used_blocks`` (ISSUE 10) gates paged-KV pool pressure from the
+``serving_step`` rows' per-iteration occupancy (threshold is a plain
+block count via ``max_value``; percentile defaults to 1.0 = the
+window's max). Only the row surfaces carry it (--log / watch); a
+metrics snapshot has no per-step series to gate.
 
 An objective with NO samples fails (a run that measured nothing cannot
 claim an SLO was met) and says so in its reason. CLI::
@@ -57,6 +64,7 @@ __all__ = [
     "load_spec", "evaluate", "samples_from_events",
     "samples_from_monitor_log", "samples_from_span_logs",
     "samples_from_metrics", "render", "main", "LATENCY_METRICS",
+    "GAUGE_METRICS",
 ]
 
 # objective metric -> metrics-snapshot histogram. step_latency is the
@@ -70,6 +78,12 @@ LATENCY_METRICS = {
     "queue_wait": "ptpu_serving_queue_wait_seconds",
     "step_latency": "ptpu_serving_step_seconds",
 }
+
+# gauge-valued objectives (thresholds are plain values, not seconds):
+# kv_used_blocks gates paged-KV pool pressure from the serving_step
+# rows' kv_used_blocks field (ISSUE 10) — an operator bounds "how full
+# may the pool run" the same way they bound a latency percentile
+GAUGE_METRICS = ("kv_used_blocks",)
 
 
 def load_spec(source):
@@ -104,11 +118,23 @@ def load_spec(source):
                 raise ValueError(
                     "objective %d percentile %r outside (0, 1]"
                     % (i, q))
+        elif metric in GAUGE_METRICS:
+            if not isinstance(obj.get("max_value"), (int, float)):
+                raise ValueError(
+                    "objective %d (%s) needs numeric 'max_value'"
+                    % (i, metric))
+            q = obj.get("percentile", 1.0)
+            if not (0.0 < float(q) <= 1.0):
+                raise ValueError(
+                    "objective %d percentile %r outside (0, 1]"
+                    % (i, q))
         else:
             raise ValueError(
                 "objective %d names unknown metric %r (known: %s, "
-                "error_rate)" % (i, metric,
-                                 ", ".join(sorted(LATENCY_METRICS))))
+                "error_rate)"
+                % (i, metric,
+                   ", ".join(sorted(list(LATENCY_METRICS)
+                                    + list(GAUGE_METRICS)))))
     return spec
 
 
@@ -117,7 +143,8 @@ def load_spec(source):
 def _empty_samples(source):
     return {"source": source, "requests": 0, "errors": 0,
             "ttft": [], "tpot": [], "queue_wait": [],
-            "step_latency": [], "histograms": {}, "skipped": 0}
+            "step_latency": [], "kv_used_blocks": [],
+            "histograms": {}, "skipped": 0}
 
 
 def samples_from_events(events, source="events"):
@@ -140,8 +167,12 @@ def samples_from_events(events, source="events"):
             for k in ("ttft", "tpot", "queue_wait"):
                 if e.get(k) is not None:
                     out[k].append(float(e[k]))
-        elif ev == "serving_step" and e.get("dt") is not None:
-            out["step_latency"].append(float(e["dt"]))
+        elif ev == "serving_step":
+            if e.get("dt") is not None:
+                out["step_latency"].append(float(e["dt"]))
+            if e.get("kv_used_blocks") is not None:
+                out["kv_used_blocks"].append(
+                    float(e["kv_used_blocks"]))
     return out
 
 
@@ -260,8 +291,10 @@ def evaluate(spec, samples):
             else:
                 ent["pass"] = measured <= threshold
         else:
-            q = float(obj.get("percentile", 0.95))
-            threshold = float(obj["max_seconds"])
+            gauge = metric in GAUGE_METRICS
+            q = float(obj.get("percentile", 1.0 if gauge else 0.95))
+            threshold = float(obj["max_value" if gauge
+                                  else "max_seconds"])
             vals = sorted(samples.get(metric) or ())
             approx = False
             if vals:
@@ -299,6 +332,8 @@ def _fmt(metric, v):
         return "n/a"
     if metric == "error_rate":
         return "%.2f%%" % (100.0 * v)
+    if metric in GAUGE_METRICS:
+        return "%g" % v
     return "%.2fms" % (1000.0 * v)
 
 

@@ -32,5 +32,5 @@ from .passes import (  # noqa: F401
     resolve_passes, maybe_transform_for_build, verify_bitwise)
 from .autoparallel import (  # noqa: F401
     ModelSpec, Plan, pipeline_utilization, candidates, plan_cost,
-    rank, recommend, apply, model_spec, embedding_wire_costs,
-    recommend_embedding_placement, PLANNABLE)
+    plan_hbm_bytes, rank, recommend, apply, model_spec,
+    embedding_wire_costs, recommend_embedding_placement, PLANNABLE)

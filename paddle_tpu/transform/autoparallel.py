@@ -23,7 +23,13 @@ numbers calibrate the analytic comm/bubble terms:
     pin is ordinal, and orderings are stable across plausible values.
 
 API:  candidates(spec, devices)       valid strategy assignments
-      rank(spec, devices)             -> [Plan] cheapest first
+      rank(spec, devices)             -> [Plan] cheapest first;
+                                      hbm_bytes= REJECTS candidates
+                                      over per-chip capacity (params +
+                                      optimizer state + paged-KV pool
+                                      via kvpool.bytes_per_block —
+                                      flag autoparallel_hbm_gb)
+      plan_hbm_bytes(spec, axes)      the capacity term itself
       recommend(model, devices)       zoo surface (traces + prices)
       apply(plan, ...)                top plan -> configured
                                       ParallelExecutor + built program
@@ -42,6 +48,11 @@ ICI_BPS = 45e9                 # assumed per-link ICI; ordinal use only
 PEAK_FLOPS = 180e12            # per-chip peak for the compute term;
                                # cancels out of every same-device-count
                                # comparison, kept for readable seconds
+# HBM capacity term (ISSUE 10): weights + grads + Adam m/v alongside
+# the parameter shard — 4x the shard bytes total (1 + this multiplier)
+OPTIMIZER_STATE_MULT = 3.0
+KV_BLOCK_SIZE = 16             # pool granule priced per plan (matches
+                               # the serving_block_size flag default)
 
 
 def pipeline_utilization(m, s):
@@ -85,11 +96,13 @@ class ModelSpec:
 class Plan:
     """One priced strategy assignment, cheapest-first sortable."""
 
-    def __init__(self, axes, microbatches, cost, breakdown):
+    def __init__(self, axes, microbatches, cost, breakdown,
+                 hbm_bytes=None):
         self.axes = dict(axes)              # dp/tp/pp/sp/ep
         self.microbatches = int(microbatches)
         self.cost = float(cost)             # modeled seconds per step
         self.breakdown = dict(breakdown)
+        self.hbm_bytes = hbm_bytes          # modeled per-chip bytes
 
     def strategy(self):
         from ..parallel import DistributedStrategy
@@ -110,11 +123,14 @@ class Plan:
         return "%s%s" % (ax, mb)
 
     def to_dict(self):
-        return {"axes": dict(self.axes),
-                "microbatches": self.microbatches,
-                "cost_s": self.cost,
-                "breakdown": dict(self.breakdown),
-                "describe": self.describe()}
+        out = {"axes": dict(self.axes),
+               "microbatches": self.microbatches,
+               "cost_s": self.cost,
+               "breakdown": dict(self.breakdown),
+               "describe": self.describe()}
+        if self.hbm_bytes is not None:
+            out["hbm_bytes"] = self.hbm_bytes
+        return out
 
     def __repr__(self):
         return "Plan(%s, cost=%.3es)" % (self.describe(), self.cost)
@@ -221,19 +237,58 @@ def plan_cost(spec, axes, microbatches=1,
     }
 
 
-def rank(spec, devices, peak_flops=PEAK_FLOPS, ici_bps=ICI_BPS):
+def plan_hbm_bytes(spec, axes, block_size=KV_BLOCK_SIZE,
+                   optimizer_mult=OPTIMIZER_STATE_MULT):
+    """Modeled PER-CHIP HBM bytes of one assignment — the capacity
+    term PR 9 left open (ISSUE 10): the dense parameter shard dp
+    replicates (tp/pp/ep shard it) times (1 + optimizer_mult) for
+    grads + Adam moments, plus the paged-KV pool a decode tier of the
+    same shape reserves, priced with ``serving.kvpool.bytes_per_block``
+    (each per-chip batch row keeps ceil(seq_shard / block_size) blocks
+    of its layer/head shard). Returns (total, breakdown)."""
+    from ..serving.kvpool import bytes_per_block
+    dp, tp, pp, sp, ep = (axes["dp"], axes["tp"], axes["pp"],
+                          axes["sp"], axes["ep"])
+    shard = spec.param_bytes / (tp * pp * max(1, ep))
+    params = shard * (1.0 + float(optimizer_mult))
+    dk = max(1, spec.d_model // max(1, spec.n_head))
+    rows = max(1, spec.batch // dp)
+    seq_shard = -(-spec.seq // sp)
+    blocks = rows * (-(-seq_shard // int(block_size)))
+    kv = blocks * bytes_per_block(
+        max(1, spec.n_layer // pp), max(1, spec.n_head // tp),
+        block_size, dk, dtype_bytes=spec.dtype_bytes)
+    return params + kv, {"hbm_param_bytes": params, "hbm_kv_bytes": kv}
+
+
+def rank(spec, devices, peak_flops=PEAK_FLOPS, ici_bps=ICI_BPS,
+         hbm_bytes=None):
     """All valid plans for (spec, devices), cheapest first. Ties break
-    on the axes tuple so the ranking is deterministic."""
-    plans = []
+    on the axes tuple so the ranking is deterministic. ``hbm_bytes``
+    (per-chip capacity) REJECTS over-capacity candidates instead of
+    ranking them — an HBM-infeasible plan is not a slow plan, it is
+    not a plan."""
+    plans, rejected = [], 0
     for axes, m in candidates(spec, devices):
+        hbm, hbm_bd = plan_hbm_bytes(spec, axes)
+        if hbm_bytes is not None and hbm_bytes > 0 and hbm > hbm_bytes:
+            rejected += 1
+            continue
         cost, breakdown = plan_cost(spec, axes, m,
                                     peak_flops=peak_flops,
                                     ici_bps=ici_bps)
-        plans.append(Plan(axes, m, cost, breakdown))
+        breakdown.update(hbm_bd)
+        plans.append(Plan(axes, m, cost, breakdown, hbm_bytes=hbm))
     plans.sort(key=lambda p: (p.cost,
                               tuple(sorted(p.axes.items())),
                               -p.microbatches))
     if not plans:
+        if rejected:
+            raise ValueError(
+                "every valid assignment for %r on %d devices exceeds "
+                "the %.2f GB per-chip HBM capacity (%d candidate(s) "
+                "rejected) — raise autoparallel_hbm_gb or shard more"
+                % (spec.name, devices, hbm_bytes / 1e9, rejected))
         raise ValueError(
             "no valid dp/tp/pp/sp/ep assignment for %r on %d devices "
             "(batch=%d heads=%d layers=%d seq=%d experts=%d)"
@@ -281,11 +336,18 @@ def _plan_entry(model):
     return mod.plan_entry()
 
 
-def recommend(model, devices, top=None, spec=None):
+def recommend(model, devices, top=None, spec=None, hbm_gb=None):
     """Ranked plans for a zoo model at a device count. ``spec`` skips
-    the trace (tests / repeated calls)."""
+    the trace (tests / repeated calls). ``hbm_gb`` (default: the
+    ``autoparallel_hbm_gb`` flag; 0 = off) rejects candidates whose
+    modeled per-chip bytes (params + optimizer state + paged-KV pool)
+    exceed the capacity."""
+    if hbm_gb is None:
+        from .. import flags
+        hbm_gb = flags.get_flag("autoparallel_hbm_gb")
     spec = spec or model_spec(model)
-    plans = rank(spec, devices)
+    plans = rank(spec, devices,
+                 hbm_bytes=hbm_gb * 1e9 if hbm_gb else None)
     return plans[:top] if top else plans
 
 

@@ -120,6 +120,51 @@ def test_dense_wins_when_every_row_is_touched():
     assert ranked[0][0] == "dense"
 
 
+# -- HBM capacity term (ISSUE 10) ------------------------------------------
+
+# on 2 devices only dp2 and tp2 are valid: n_layer=3 rejects pp2,
+# seq=255 rejects sp2, no experts rejects ep2 — so the capacity filter
+# decides between a replicated-params plan (tp=1) and a sharded one
+HBM_SPEC = ap.ModelSpec("hbm", flops=1e12, bytes=1e9, param_bytes=1e9,
+                        batch=8, seq=255, d_model=512, n_layer=3,
+                        n_head=8)
+
+
+def test_plan_hbm_bytes_accounting():
+    """params shard * (1 + optimizer mult) + the paged-KV pool priced
+    through kvpool.bytes_per_block — hand-computed for both plans."""
+    from paddle_tpu.serving.kvpool import bytes_per_block
+    dp2 = {"dp": 2, "tp": 1, "pp": 1, "sp": 1, "ep": 1}
+    tp2 = {"dp": 1, "tp": 2, "pp": 1, "sp": 1, "ep": 1}
+    total, bd = ap.plan_hbm_bytes(HBM_SPEC, dp2)
+    # dp replicates the FULL 1 GB of params (+3x optimizer state);
+    # KV: 4 rows/chip * ceil(255/16)=16 blocks of the full L/H shard
+    assert bd["hbm_param_bytes"] == pytest.approx(4e9)
+    assert bd["hbm_kv_bytes"] == pytest.approx(
+        4 * 16 * bytes_per_block(3, 8, 16, 64, 4))
+    assert total == pytest.approx(bd["hbm_param_bytes"]
+                                  + bd["hbm_kv_bytes"])
+    total_tp, bd_tp = ap.plan_hbm_bytes(HBM_SPEC, tp2)
+    assert bd_tp["hbm_param_bytes"] == pytest.approx(2e9)  # sharded
+    assert total_tp < total
+
+
+def test_hbm_capacity_filters_tp1_keeps_tp2():
+    """The ISSUE-10 pin: with a per-chip capacity between the two
+    plans' footprints, the over-capacity tp1 (dp2) candidate is
+    REJECTED — not merely ranked worse — while tp2 survives; an
+    impossible capacity fails loudly naming the constraint."""
+    plans = ap.rank(HBM_SPEC, 2)
+    axes = {tuple(sorted(p.axes.items())) for p in plans}
+    assert len(axes) == 2                   # dp2 and tp2 only
+    assert all(p.hbm_bytes is not None for p in plans)
+    fit = ap.rank(HBM_SPEC, 2, hbm_bytes=3e9)
+    assert fit and all(p.axes["tp"] == 2 for p in fit)
+    assert all(p.hbm_bytes <= 3e9 for p in fit)
+    with pytest.raises(ValueError, match="HBM capacity"):
+        ap.rank(HBM_SPEC, 2, hbm_bytes=1e6)
+
+
 # -- zoo surface: transformer at 8 virtual devices -------------------------
 
 @pytest.fixture(scope="module")

@@ -10,6 +10,14 @@ the trace id, engine.step spans) plus the core/executor feed-plan cache
 (no fresh normalization on a repeated-shape call, committed-buffer
 zero-copy reuse) behave as documented.
 
+Since ISSUE 10 the engine default is the PAGED KV layout (shared block
+pool + per-slot block tables), so every identity pin in this module —
+slot recycling, multi-chunk prefill, mid-flight admission, bf16,
+megastep K>1, full ISSUE-6 instrumentation — now gates the paged step.
+The EOS test pins paged=False so the PR-5 dense layout keeps its own
+token-identity gate; tests/test_kvpool.py holds the paged-only pins
+(prefix-cache hit vs cold, COW, preemption-and-resume, sampling).
+
 The LM, its sequential-baseline jit and ONE engine are module-scoped:
 each Engine carries three compiled functions, and on this suite's
 single-core CPU budget recompiling them per test would cost more than
@@ -111,12 +119,15 @@ def test_engine_token_identical_mid_flight_admission(rng, lm, eng4):
     _assert_identical(seq, out)
 
 
-def test_engine_eos_retirement(rng, lm):
+def test_engine_eos_retirement_dense(rng, lm):
     """A request whose greedy continuation hits EOS retires early (its
     slot refills) and the emitted tokens — EOS included — match the
     sequential baseline. The EOS id is picked from an observed
     continuation so the path triggers deterministically; the model copy
-    shares weights (and the baseline's compiled step) with ``lm``."""
+    shares weights (and the baseline's compiled step) with ``lm``.
+    Runs ``paged=False``: with the engine default now PAGED (ISSUE 10,
+    the rest of this module), this is the pin that keeps the PR-5
+    dense slot layout token-identical too."""
     probe = ([1, 5, 9], 12)
     [(toks, _)] = serving.sequential_generate(lm, [probe])
     lm_eos = copy.copy(lm)
@@ -124,7 +135,9 @@ def test_engine_eos_retirement(rng, lm):
     reqs = [probe] + _requests(rng, 3, min_new=6, max_new=10)
     seq = serving.sequential_generate(lm_eos, reqs)
     assert len(seq[0][0]) == 3 and seq[0][0][-1] == lm_eos.end_id
-    with serving.Engine(lm_eos, slots=2, prefill_chunk=4) as eng:
+    with serving.Engine(lm_eos, slots=2, prefill_chunk=4,
+                        paged=False) as eng:
+        assert eng._paged is False
         out = eng.generate_many([p for p, _ in reqs],
                                 [m for _, m in reqs])
     _assert_identical(seq, out)
@@ -466,7 +479,8 @@ def test_serving_bench_fast_smoke(rng):
     sys.argv = ["serving_bench.py", "--device", "CPU", "--fast",
                 "--requests", "5", "--max_prompt", "8",
                 "--max_new", "32", "--d_model", "64", "--n_head", "2",
-                "--vocab", "256", "--max_len", "48"]
+                "--vocab", "256", "--max_len", "48",
+                "--prefix_share", "24"]
     try:
         import importlib
         import serving_bench
@@ -479,3 +493,12 @@ def test_serving_bench_fast_smoke(rng):
     assert out["slots"] >= 4
     assert 0.0 < out["occupancy"] <= 1.0
     assert out["tokens"] > 60
+    # ISSUE-10 acceptance: the shared-system-prompt A/B stamps a
+    # NONZERO prefix hit rate, executes FEWER prefill chunks than the
+    # dense arm (the measured prefill-compute saving), and both arms
+    # stay token-identical to the sequential baseline
+    assert out["prefix_identical"] is True
+    assert out["prefix_hit_rate"] > 0
+    assert out["prefix_chunks_paged"] < out["prefix_chunks_dense"]
+    assert out["kv_pool_blocks"] > 0
+    assert 0 < out["kv_peak_blocks"] <= out["kv_pool_blocks"]
