@@ -692,6 +692,27 @@ def main():
         out["device_kind"] = getattr(dev, "device_kind", "")
     except Exception:
         pass
+    try:
+        # perf regression verdict vs the previous checked-in round
+        # (ISSUE 11): the paddle_tpu.perfgate probe comparison with
+        # explicit per-probe noise bands — platform-mismatched rounds
+        # skip rather than scream. Advisory here (the round always
+        # stamps); the CLI is the exit-code gate.
+        from paddle_tpu import perfgate
+        base = perfgate.latest_baseline(
+            os.path.dirname(os.path.abspath(__file__)))
+        if base is not None:
+            v = perfgate.compare(out, base)
+            out["perfgate"] = {
+                "baseline": os.path.basename(base),
+                "pass": v["pass"],
+                "compared": v["compared"],
+                "regressions": v["regressions"],
+                "improvements": v["improvements"],
+            }
+            print(perfgate.render(v), file=sys.stderr)
+    except Exception as e:
+        errors.setdefault("perfgate", []).append(repr(e))
     if errors:
         # per-config failures (after retries): the record names what
         # was skipped instead of the whole round vanishing

@@ -80,6 +80,12 @@ from . import trace  # noqa: F401
 trace.maybe_enable_from_flags()
 from . import serving  # noqa: F401
 from . import distributed  # noqa: F401
+
+# PADDLE_TPU_TELEMETRY=1 arms the scrape-only fleet-telemetry endpoint
+# (needs the distributed tier imported: it serves the shared RPC frames)
+from .monitor import collector as _collector  # noqa: E402
+
+_collector.maybe_arm_from_flags()
 from .distributed import DistributeTranspiler  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401
 from . import parallel  # noqa: F401

@@ -20,6 +20,9 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.resilience.driver",
     "paddle_tpu.monitor",
     "paddle_tpu.monitor.watch",
+    "paddle_tpu.monitor.collector",
+    "paddle_tpu.monitor.goodput",
+    "paddle_tpu.perfgate",
     "paddle_tpu.serving",
     "paddle_tpu.serving.engine",
     "paddle_tpu.serving.fleet",

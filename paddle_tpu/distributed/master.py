@@ -21,7 +21,8 @@ import socketserver
 import threading
 import time
 
-from .rpc import _send_msg, _recv_msg, _clock_exchange, _clock_reply
+from .rpc import (_send_msg, _recv_msg, _clock_exchange, _clock_reply,
+                  _metr_reply, _hlth_reply)
 from ..monitor import metrics as _metrics
 from ..monitor import runtime as _mon
 from ..resilience import faults as _faults
@@ -221,6 +222,10 @@ class MasterServer:
                       json.dumps(self.queue.counts()).encode())
         elif op == "CLKS":
             _clock_reply(sock)
+        elif op == "METR":
+            _metr_reply(sock, payload, role="master")
+        elif op == "HLTH":
+            _hlth_reply(sock, role="master")
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

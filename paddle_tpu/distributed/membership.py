@@ -21,14 +21,25 @@ import threading
 import time
 import uuid
 
-from .rpc import _send_msg, _recv_msg, _clock_reply
+from .rpc import (_send_msg, _recv_msg, _clock_reply, _metr_reply,
+                  _hlth_reply)
 from ..monitor import metrics as _metrics
 from ..trace import clock as _clock
 from ..trace import runtime as _trace
 
 __all__ = ["KVServer", "KVClient", "register_endpoint",
            "wait_for_endpoints", "live_endpoints", "role_prefix",
-           "register_pserver", "wait_for_pservers", "TrainerLease"]
+           "register_pserver", "wait_for_pservers", "TrainerLease",
+           "EVICTED_PREFIX"]
+
+# Registry-level tombstone protocol: an evictor (serving.fleet's
+# Router) CASes a slot's endpoint to "evicted:<ep>" instead of
+# deleting it — the wedged holder's expect-guarded keepalive then
+# loses (split-brain guard doubling as eviction), the supervisor
+# frees the slot with compare-and-delete, and registry READERS (the
+# fleet router, monitor.collector discovery) filter these values.
+# Lives here because every consumer of the registry shares it.
+EVICTED_PREFIX = "evicted:"
 
 _REG = _metrics.registry()
 _HEARTBEATS = _REG.counter("ptpu_lease_heartbeats_total",
@@ -187,6 +198,10 @@ class KVServer:
                     _send_msg(sock, "OK")
         elif op == "CLKS":
             _clock_reply(sock)
+        elif op == "METR":
+            _metr_reply(sock, payload, role="kv")
+        elif op == "HLTH":
+            _hlth_reply(sock, role="kv")
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

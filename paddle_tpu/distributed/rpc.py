@@ -19,6 +19,12 @@ propagation and the retry policy apply to all of them for free):
     kv store  PUT GET CAS DEL CAD LIST LEAS   (membership.py)
     serving   SUBM POLL CANC STAT        (serving/fleet.py replicas)
     all       CLKS                       (trace clock probes)
+    all       METR HLTH                  (fleet telemetry scrape:
+                                          registry snapshot + recorder
+                                          delta / liveness — served by
+                                          every dispatch loop plus
+                                          monitor.collector's
+                                          TelemetryServer)
 """
 
 import itertools
@@ -289,6 +295,66 @@ def _clock_reply(sock):
     dispatchers): reply with this process's epoch clock, stamped as
     late as possible so the sample sits at the handling midpoint."""
     _send_msg(sock, "OK", "", json.dumps({"t": time.time()}).encode())
+
+
+def _metr_reply(sock, payload, role="proc", registry=None):
+    """Serve one METR scrape (fleet telemetry): the full metrics
+    registry snapshot (incarnation + uptime stamped by the registry
+    itself) plus the flight-recorder event delta since the caller's
+    cursor (empty when no recorder is armed — counters alone still
+    make the process observable). Shared by every dispatch loop; the
+    reply is one JSON frame, so faults/trace/retry ride along exactly
+    like any other verb."""
+    body = {}
+    if payload:
+        try:
+            body = json.loads(bytes(payload).decode())
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+    reg = registry if registry is not None else _metrics.registry()
+    out = {"role": role, "pid": os.getpid(),
+           "incarnation": reg.incarnation, "uptime_s": reg.uptime_s(),
+           "snapshot": reg.snapshot(),
+           "events": [], "cursor": body.get("cursor"), "lost": 0}
+    # a collector scraping several endpoints of the SAME process asks
+    # only its designated primary for the event delta ("events": false
+    # on the others) — the ring cursor advances once per process. The
+    # ring belongs to the process-GLOBAL identity: a server pinning a
+    # private registry override reports a different incarnation, and
+    # serving it the global ring would double-deliver every event
+    # (two "processes", each a primary of the one ring).
+    rec = _mon.recorder() if registry is None else None
+    if rec is not None and body.get("events", True):
+        try:
+            # cursors are only meaningful within ONE ring's sequence
+            # space: monitor.enable() mid-process replaces the
+            # recorder, and the caller's old-ring cursor would
+            # silently filter every new row — reply with the ring id
+            # and restart the delta when the caller's doesn't match
+            cursor = body.get("cursor")
+            if body.get("ring") is not None \
+                    and body.get("ring") != rec.ring_id:
+                cursor = None
+            cur, rows, lost = rec.events_since(cursor)
+            out["events"] = rows
+            out["cursor"] = cur
+            out["lost"] = lost
+            out["ring"] = rec.ring_id
+        except Exception:
+            pass            # telemetry must never fail the server loop
+    _send_msg(sock, "VAL", "", json.dumps(out).encode())
+
+
+def _hlth_reply(sock, role="proc", registry=None):
+    """Serve one HLTH liveness probe: who am I (role / pid /
+    incarnation) and how long have I been up — the cheap half of the
+    scrape a collector uses to paint fleet membership without pulling
+    a whole registry snapshot."""
+    reg = registry if registry is not None else _metrics.registry()
+    _send_msg(sock, "VAL", "", json.dumps(
+        {"role": role, "pid": os.getpid(), "alive": True,
+         "incarnation": reg.incarnation,
+         "uptime_s": reg.uptime_s()}).encode())
 
 
 def _parse_tag(tag):
@@ -577,6 +643,10 @@ class VariableServer:
                 _send_msg(sock, "OK")   # async mode: barrier is a no-op
         elif op == "CLKS":
             _clock_reply(sock)
+        elif op == "METR":
+            _metr_reply(sock, payload, role="pserver")
+        elif op == "HLTH":
+            _hlth_reply(sock, role="pserver")
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

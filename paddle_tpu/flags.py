@@ -41,6 +41,9 @@ Registered flags:
                         stall-watchdog deadline)
   megastep_inflight int Executor.run_steps async dispatch window depth
                         (2 = double buffering)
+  telemetry*      —     monitor.collector scrape-only TelemetryServer
+                        (arm at import, port, membership KV endpoint
+                        to self-register with for fleet discovery)
   slo_spec        str   default SLO spec JSON for python -m
                         paddle_tpu.slo and the live verdict line of
                         python -m paddle_tpu.monitor watch
@@ -240,6 +243,23 @@ _register("megastep_inflight", int, 2,
           "oldest. 2 = double buffering (host feed of megastep N+1 "
           "overlaps device compute of megastep N); 1 restores "
           "serialized dispatch")
+_register("telemetry", bool, False,
+          "arm the scrape-only monitor.collector.TelemetryServer at "
+          "import: any trainer/engine process becomes METR/HLTH "
+          "scrapeable by a fleet collector even without hosting a "
+          "pserver/master/replica dispatch loop")
+_register("telemetry_port", int, 0,
+          "TelemetryServer listen port (0 = ephemeral; the endpoint "
+          "self-registers when telemetry_kv is set)")
+_register("telemetry_kv", str, "",
+          "membership KV endpoint (host:port) the armed "
+          "TelemetryServer registers its endpoint with (role "
+          "'telemetry', TTL lease) so collectors discover this "
+          "process without configuration; empty = serve unregistered")
+_register("telemetry_slots", int, 16,
+          "how many 'telemetry' role slots the lease registry offers "
+          "(register_endpoint desired count for flag-armed "
+          "TelemetryServers)")
 _register("slo_spec", str, "",
           "default SLO spec JSON path: python -m paddle_tpu.slo uses "
           "it when no spec argument is given, and python -m "

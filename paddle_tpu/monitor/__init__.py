@@ -1,10 +1,18 @@
 """paddle_tpu.monitor — always-available runtime telemetry.
 
-Three pieces (see each module's docstring):
+The pieces (see each module's docstring):
   metrics   thread-safe Counter/Gauge/Histogram registry + Prometheus
-            text / JSON export
+            text / JSON export (+ bucket-wise histogram merge and the
+            incarnation/uptime snapshot stamp the fleet scraper keys
+            restart detection on)
   recorder  bounded JSONL flight recorder of structured run events
+            (+ a bounded in-memory ring served as the METR scrape
+            delta)
   watchdog  stall detector that dumps all thread stacks
+  collector fleet telemetry plane: METR/HLTH scrape over RPC,
+            exact-sum merge, one fleet-labeled re-export (imported
+            lazily — it needs the distributed tier)
+  goodput   goodput/badput wall-time attribution over recorder rows
 
 Quickstart::
 

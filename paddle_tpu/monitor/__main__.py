@@ -5,6 +5,10 @@
         [--window N] [--once] [--slo spec.json]
     python -m paddle_tpu.monitor watch rep0.jsonl rep1.jsonl ...
         # serving fleet: one log per replica, dashboard over the union
+    python -m paddle_tpu.monitor watch --fleet <kv-endpoint>
+        # LIVE fleet scrape over RPC (monitor/collector.py) — no files
+    python -m paddle_tpu.monitor goodput run.jsonl [rep1.jsonl ...]
+        # goodput/badput wall-time attribution (monitor/goodput.py)
 
 The summary covers BOTH workloads a log may carry: training `step`
 rows (step count, latency percentiles, compile/recompile causes, MFU,
@@ -87,7 +91,9 @@ def _summarize_serving(events):
     if not sstep and not sreq:
         return None
     from .. import slo as _slo
-    s = _slo.samples_from_events(events)
+    # latency/request fields only — the goodput ledger has its own
+    # subcommand, no need to sweep the whole file here
+    s = _slo.samples_from_events(events, compute_goodput=False)
     sdts = sorted(s["step_latency"])
     ttft = sorted(s["ttft"])
     tpot = sorted(s["tpot"])
@@ -169,15 +175,27 @@ def render(s):
 
 
 def _watch_main(argv):
-    from .watch import watch
+    from .watch import watch, watch_fleet
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.monitor watch",
         description="Tail a flight-recorder log and render a live "
-                    "terminal dashboard")
-    p.add_argument("log", nargs="+",
+                    "terminal dashboard (or --fleet for the live "
+                    "scraped fleet view — no files)")
+    p.add_argument("log", nargs="*",
                    help="flight-recorder .jsonl path(s) — one per "
                         "replica for a serving fleet; the dashboard "
                         "aggregates the union")
+    p.add_argument("--fleet", default=None, metavar="KV_ENDPOINT",
+                   help="live fleet scrape: discover processes from "
+                        "this membership KV registry (host:port) and "
+                        "scrape their metrics over RPC instead of "
+                        "tailing files")
+    p.add_argument("--endpoint", action="append", default=[],
+                   metavar="ROLE=HOST:PORT",
+                   help="extra static scrape endpoint for --fleet "
+                        "(e.g. master=127.0.0.1:7164; repeatable — "
+                        "the master and KV server are not "
+                        "lease-registered)")
     p.add_argument("--interval", type=float, default=2.0,
                    help="seconds between refreshes (default 2)")
     p.add_argument("--window", type=int, default=256,
@@ -190,6 +208,9 @@ def _watch_main(argv):
                         "rolling request window (default: the "
                         "PADDLE_TPU_SLO_SPEC flag when set)")
     args = p.parse_args(argv)
+    if not args.log and args.fleet is None and not args.endpoint:
+        p.error("pass log file(s), or --fleet/--endpoint for the "
+                "live scrape")
     slo_spec = args.slo
     if slo_spec is None:
         from .. import flags
@@ -206,6 +227,23 @@ def _watch_main(argv):
                                                   "(from flag)", e),
                   file=sys.stderr)
             return 2
+    if args.fleet is not None or args.endpoint:
+        if args.log:
+            print("watch: --fleet scrapes live endpoints; log files "
+                  "are ignored with it", file=sys.stderr)
+        static = []
+        for s in args.endpoint:
+            if "=" not in s:
+                print("watch: --endpoint wants ROLE=HOST:PORT, got %r"
+                      % s, file=sys.stderr)
+                return 2
+            role, ep = s.split("=", 1)
+            static.append((role, ep))
+        frame = watch_fleet(kv_endpoint=args.fleet, static=static,
+                            interval=args.interval,
+                            window=args.window, once=args.once,
+                            slo_spec=slo_spec)
+        return 1 if args.once and frame is None else 0
     frame = watch(args.log, interval=args.interval, window=args.window,
                   once=args.once, slo_spec=slo_spec)
     # --once on a log that does not exist is a scripting error (1);
@@ -213,10 +251,33 @@ def _watch_main(argv):
     return 1 if args.once and frame is None else 0
 
 
+def _goodput_main(argv):
+    from . import goodput as gp
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.monitor goodput",
+        description="Goodput/badput wall-time attribution over "
+                    "flight-recorder log(s) — one per process; "
+                    "several render a fleet rollup")
+    p.add_argument("log", nargs="+",
+                   help="flight-recorder .jsonl path(s)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the ledger as one JSON object")
+    args = p.parse_args(argv)
+    try:
+        report = gp.ledger(args.log)
+    except OSError as e:
+        print("goodput: unreadable log: %s" % e, file=sys.stderr)
+        return 2
+    print(json.dumps(report) if args.json else gp.render(report))
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "watch":
         return _watch_main(argv[1:])
+    if argv and argv[0] == "goodput":
+        return _goodput_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.monitor",
         description="Summarize a paddle_tpu.monitor flight-recorder "

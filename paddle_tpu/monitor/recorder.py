@@ -24,10 +24,12 @@ Event types written by the serving tier (paddle_tpu.serving):
                    REQUEST's trace id, error when failed)
 """
 
+import collections
 import json
 import os
 import threading
 import time
+import uuid
 
 __all__ = ["FlightRecorder"]
 
@@ -44,14 +46,32 @@ def percentile_sorted(sorted_vals, q):
 _DEFAULT_MAX_BYTES = 64 << 20
 
 
+_DEFAULT_RING = 2048
+
+
 class FlightRecorder:
-    def __init__(self, path, max_bytes=_DEFAULT_MAX_BYTES):
+    def __init__(self, path, max_bytes=_DEFAULT_MAX_BYTES,
+                 ring=_DEFAULT_RING):
         self.path = path
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         self._bytes = 0
         self._dropped = 0
         self._truncated_written = False
+        # bounded in-memory tail of recent events, each stamped with a
+        # monotonically increasing sequence number: the live scrape
+        # surface (rpc METR serves "rows since cursor" from here, so a
+        # fleet collector streams events without tailing N files). It
+        # keeps filling past the on-disk byte cap — the cap bounds the
+        # DISK, the ring is bounded by construction. ring_id names THIS
+        # recorder's sequence space: monitor.enable() replaces the
+        # recorder (sequence restarts) without the process restarting,
+        # and a scraper whose cursor came from the OLD ring must learn
+        # its cursor is meaningless rather than silently filter every
+        # new row against it.
+        self.ring_id = uuid.uuid4().hex[:12]
+        self._ring = collections.deque(maxlen=int(ring))
+        self._seq = 0
         d = os.path.dirname(os.path.abspath(path))
         if d:
             os.makedirs(d, exist_ok=True)
@@ -83,6 +103,13 @@ class FlightRecorder:
         with self._lock:
             if self._f is None:
                 return False
+            # the ring sees every event the recorder accepted, byte
+            # cap or not. It stores the ENCODED line (the same bytes
+            # the file gets, degraded reprs included): parsing happens
+            # at scrape time in events_since — bounded by the ring and
+            # rare — not once per hot-path record
+            self._seq += 1
+            self._ring.append((self._seq, line))
             if self._truncated_written:
                 # the truncated marker is FINAL: smaller events after a
                 # large overflowing one must not slip in past it, or the
@@ -118,6 +145,30 @@ class FlightRecorder:
     def dropped(self):
         with self._lock:
             return self._dropped
+
+    def events_since(self, cursor=None):
+        """Ring rows newer than ``cursor`` (a sequence number from a
+        previous call; None = everything still in the ring). Returns
+        ``(new_cursor, rows, lost)`` where ``lost`` counts rows that
+        aged out of the bounded ring between scrapes — a slow scraper
+        learns it missed events instead of silently under-counting."""
+        with self._lock:
+            if cursor is None:
+                rows = list(self._ring)
+                lost = 0
+            else:
+                cursor = int(cursor)
+                rows = [(s, r) for s, r in self._ring if s > cursor]
+                oldest = self._ring[0][0] if self._ring else \
+                    self._seq + 1
+                lost = max(0, oldest - cursor - 1)
+            new_cursor = rows[-1][0] if rows else \
+                (self._seq if cursor is None else max(cursor,
+                                                      self._seq))
+        # parse OUTSIDE the lock: a full-ring scrape decodes up to
+        # `ring` lines, and record() on the hot path must not wait
+        # behind it
+        return new_cursor, [json.loads(r) for _, r in rows], lost
 
     def flush(self):
         with self._lock:

@@ -10,12 +10,19 @@ stall / NaN / truncation indicators and an optional live SLO verdict.
     python -m paddle_tpu.monitor watch rep0.jsonl rep1.jsonl ...
                        # serving fleet: one log per replica, the
                        # dashboard (and --slo verdict) covers the union
+    python -m paddle_tpu.monitor watch --fleet <kv-endpoint>
+                       # LIVE fleet scrape: discover every process
+                       # from the membership lease registry, scrape
+                       # metrics + recorder deltas over RPC (METR),
+                       # and render the merged dashboard — no files
 
 The tail is incremental (only new bytes are parsed per refresh) and
 tolerant: a torn trailing line — the writer is LIVE — is retried on
 the next refresh, never fatal. Rolling figures cover the last
 ``--window`` rows of each kind; totals (steps, requests, stalls) cover
-the whole log.
+the whole log. Multi-log mode prints a per-log staleness line —
+seconds since each file's last row — so a dead replica's SILENCE is
+visible instead of silently aging out of the rolling window.
 """
 
 import collections
@@ -25,7 +32,8 @@ import time
 
 from .recorder import percentile_sorted as _pct
 
-__all__ = ["watch", "WatchState", "render_frame"]
+__all__ = ["watch", "watch_fleet", "WatchState", "render_frame",
+           "staleness_lines", "fleet_lines"]
 
 
 class _Tail:
@@ -67,6 +75,16 @@ class WatchState:
         self.serving_steps = collections.deque(maxlen=self.window)
         self.requests = collections.deque(maxlen=self.window)
         self.train_steps = collections.deque(maxlen=self.window)
+        # rolling RAW-event window per source (one per log file /
+        # scraped process): the goodput ledger must attribute each
+        # process's own wall clock, never a union timeline, and needs
+        # every timestamped row kind (steps, compiles, stalls,
+        # markers), not just the serving deques above. LRU-bounded:
+        # under supervisor respawn churn every new replica endpoint is
+        # a fresh source key, and a dashboard must not grow (or keep
+        # verdict-voting dead processes' last windows) forever.
+        self.goodput_events = collections.OrderedDict()
+        self.max_sources = 64
         self.events = 0
         self.skipped = 0
         self.total_serving_steps = 0
@@ -80,10 +98,10 @@ class WatchState:
         self.platform = None
         self.last_ts = None
 
-    def feed_line(self, line):
+    def feed_line(self, line, source=""):
         e = self.parse_line(line)
         if e is not None:
-            self.feed_event(e)
+            self.feed_event(e, source=source)
 
     def parse_line(self, line):
         """One JSONL line -> event dict, or None (counted skipped)."""
@@ -97,10 +115,19 @@ class WatchState:
             return None
         return e
 
-    def feed_event(self, e):
+    def feed_event(self, e, source=""):
         self.events += 1
         if e.get("ts") is not None:
             self.last_ts = e["ts"]
+            key = source or ""
+            dq = self.goodput_events.get(key)
+            if dq is None:
+                dq = self.goodput_events[key] = collections.deque(
+                    maxlen=self.window)
+            dq.append(e)
+            self.goodput_events.move_to_end(key)
+            while len(self.goodput_events) > self.max_sources:
+                self.goodput_events.popitem(last=False)
         ev = e["ev"]
         if ev == "serving_step":
             # a fused megastep row advances k logical steps (dt stays
@@ -128,12 +155,23 @@ class WatchState:
     def request_samples(self):
         """SLO-engine-shaped samples over the rolling request window
         (what --slo evaluates live) — delegates to the slo module's
-        one rows->samples extraction."""
+        one rows->samples extraction. goodput comes from the
+        per-SOURCE raw-event windows rolled up per process (the
+        request/serving deques alone would misattribute a training
+        log and collapse a fleet's concurrent timelines)."""
         import itertools
         from .. import slo as _slo
-        return _slo.samples_from_events(
+        from . import goodput as _goodput
+        out = _slo.samples_from_events(
             itertools.chain(self.requests, self.serving_steps),
-            source="watch window")
+            source="watch window", compute_goodput=False)
+        if self.goodput_events:
+            out["goodput"] = _goodput.rollup(
+                _goodput.ledger_from_events(evs)
+                for evs in self.goodput_events.values())
+        else:
+            out["goodput"] = None
+        return out
 
 
 def _ms(v):
@@ -144,14 +182,99 @@ def _p(vals, q):
     return _pct(sorted(vals), q) if vals else None
 
 
-def render_frame(state, path, slo_verdict=None, now=None):
+def staleness_lines(last_ts, now=None, stale_after=5.0):
+    """Per-log staleness indicator for multi-log mode: one line per
+    file with seconds since ITS last row, so a dead replica's silence
+    is visible instead of quietly aging out of the rolling window.
+    ``last_ts``: {path: newest row ts or None}. With ``now`` (live
+    loop) ages are absolute; without it (--once, deterministic) they
+    are relative to the newest row across all logs."""
+    if len(last_ts) < 2:
+        return []
+    base = now
+    if base is None:
+        known = [t for t in last_ts.values() if t is not None]
+        if not known:
+            return []
+        base = max(known)
+    out = []
+    for path in sorted(last_ts):
+        t = last_ts[path]
+        if t is None:
+            out.append("  %-40s no rows yet" % path)
+            continue
+        age = max(0.0, base - t)
+        flag = "   [STALE]" if age >= stale_after else ""
+        out.append("  %-40s last row %5.1fs ago%s" % (path, age, flag))
+    return ["logs"] + out
+
+
+def _fleet_counter(snap, name):
+    """Summed counter value, or None when the metric is ABSENT — a
+    present-but-zero counter must not read as missing (the requests
+    line falls back to admissions only when no router counted at
+    all)."""
+    ent = snap.get(name) or {}
+    if ent.get("kind") != "counter":
+        return None
+    return sum(ent.get("series", {}).values())
+
+
+def fleet_lines(fleet_snap, now=None):
+    """Fleet header for the scraped dashboard: one line per endpoint
+    (role, liveness, uptime, scrape staleness) plus the merged fleet
+    counters — the collector's exact-sum view."""
+    from .metrics import META_KEY
+    meta = fleet_snap.get(META_KEY) or {}
+    eps = meta.get("endpoints") or []
+    lines = ["fleet     %d process(es), %d endpoint(s), %d scrape(s)%s"
+             % (meta.get("processes", 0), len(eps),
+                meta.get("scrapes", 0),
+                "   [%d event(s) LOST to ring overflow]"
+                % meta["events_lost"] if meta.get("events_lost")
+                else "")]
+    for ep in eps:
+        status = "up" if ep.get("ok") else "DOWN"
+        up = ep.get("uptime_s")
+        age = ep.get("age_s")
+        lines.append(
+            "  %-8s %-22s %-4s uptime %-8s scraped %s"
+            % (ep.get("role", "?"), ep.get("endpoint", "?"), status,
+               "n/a" if up is None else "%.0fs" % up,
+               "n/a" if age is None else "%.1fs ago" % age))
+    steps = _fleet_counter(fleet_snap, "ptpu_steps_total")
+    tokens = _fleet_counter(fleet_snap, "ptpu_serving_tokens_total")
+    reqs = _fleet_counter(fleet_snap, "ptpu_fleet_requests_total")
+    if reqs is None:          # no router in the fleet: engine-level
+        reqs = _fleet_counter(fleet_snap,
+                              "ptpu_serving_admissions_total")
+    errs = _fleet_counter(fleet_snap,
+                          "ptpu_serving_request_failures_total")
+    rounds = _fleet_counter(fleet_snap, "ptpu_ps_rounds_total")
+    lines.append(
+        "  totals   steps %d   serving tokens %d   requests %d   "
+        "errors %d   ps rounds %d" % (steps or 0, tokens or 0,
+                                      reqs or 0, errs or 0,
+                                      rounds or 0))
+    return lines
+
+
+def render_frame(state, path, slo_verdict=None, now=None,
+                 staleness=None, fleet=None):
     """One frame of the dashboard as a string (the ``--once`` / test
-    surface; the live loop wraps it in an ANSI clear)."""
+    surface; the live loop wraps it in an ANSI clear). ``staleness``:
+    {path: last row ts} for the multi-log per-file indicator;
+    ``fleet``: a collector fleet snapshot for the scraped-dashboard
+    header."""
     lines = ["paddle_tpu monitor watch — %s   %d events (%s)"
              % (path, state.events, state.platform or "?")]
     if state.last_ts is not None and now is not None:
         age = max(0.0, now - state.last_ts)
         lines[0] += "   last event %.1fs ago" % age
+    if fleet is not None:
+        lines.extend(fleet_lines(fleet, now=now))
+    if staleness:
+        lines.extend(staleness_lines(staleness, now=now))
 
     if state.serving_steps:
         dts = [s["dt"] for s in state.serving_steps
@@ -239,7 +362,9 @@ def render_frame(state, path, slo_verdict=None, now=None):
             "%s %s%s" % ("PASS" if r["pass"] else "FAIL", r["metric"],
                          ("=" + _ms(r["measured"]))
                          if r["measured"] is not None
-                         and r["metric"] != "error_rate" else "")
+                         and r["metric"] not in ("error_rate",
+                                                 "goodput_fraction")
+                         else "")
             for r in slo_verdict["objectives"])
         lines.append("slo       %s   %s"
                      % ("PASS" if slo_verdict["pass"] else "FAIL",
@@ -267,6 +392,7 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
         spec = _slo.load_spec(slo_spec)
     state = WatchState(window=window)
     tails = [_Tail(p) for p in paths]
+    last_ts = {p: None for p in paths}   # per-log staleness indicator
     frames = 0
     try:
         while True:
@@ -288,21 +414,26 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
             # dashboard exists to avoid. Stable sort keeps each file's
             # own order for ts-less rows.
             events = []
-            for lines in polls:
+            for t, lines in zip(tails, polls):
                 for line in lines or ():
                     e = state.parse_line(line)
                     if e is not None:
-                        events.append(e)
-            events.sort(key=lambda e: (e.get("ts") is None,
-                                       e.get("ts") or 0.0))
-            for e in events:
-                state.feed_event(e)
+                        events.append((e, t.path))
+                        if e.get("ts") is not None:
+                            last_ts[t.path] = max(
+                                last_ts[t.path] or 0.0, e["ts"])
+            events.sort(key=lambda pair: (pair[0].get("ts") is None,
+                                          pair[0].get("ts") or 0.0))
+            for e, src in events:
+                state.feed_event(e, source=src)
             verdict = None
             if spec is not None:
                 from .. import slo as _slo
                 verdict = _slo.evaluate(spec, state.request_samples())
             frame = render_frame(state, label, slo_verdict=verdict,
-                                 now=None if once else time.time())
+                                 now=None if once else time.time(),
+                                 staleness=last_ts
+                                 if len(paths) > 1 else None)
             if once:
                 out.write(frame + "\n")
                 return frame
@@ -317,3 +448,83 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
     finally:
         for t in tails:
             t.close()
+
+
+def watch_fleet(kv_endpoint=None, static=(), interval=2.0, window=256,
+                once=False, out=None, slo_spec=None, max_frames=None,
+                collector=None):
+    """The LIVE scraped dashboard (``watch --fleet``): a
+    ``monitor.collector.Collector`` discovers the fleet from the
+    membership lease registry (plus ``static`` (role, endpoint)
+    pairs), scrapes every process's registry + flight-recorder delta
+    over RPC each ``interval``, and renders the merged frame —
+    replacing the PR-8 pattern of tailing one JSONL per replica.
+    The SLO verdict line evaluates ``slo_spec`` against the rolling
+    scraped request rows when any process streams recorder events,
+    falling back to the merged fleet METRICS snapshot (approximate,
+    bucket-interpolated) when none does — one spec gates the whole
+    fleet either way."""
+    from .collector import Collector
+    if out is None:
+        out = sys.stdout
+    spec = None
+    if slo_spec:
+        from .. import slo as _slo
+        spec = _slo.load_spec(slo_spec)
+    col = collector if collector is not None else Collector(
+        kv_endpoint=kv_endpoint, static=static)
+    own_col = collector is None
+    state = WatchState(window=window)
+    label = kv_endpoint or ", ".join(ep for _, ep in static) \
+        or "scrape"
+    frames = 0
+    try:
+        while True:
+            for e in col.scrape_once():
+                # scraped rows carry proc = "role@endpoint": the
+                # per-process key the rolling goodput rollup needs
+                state.feed_event(e, source=e.get("proc") or "")
+            snap = col.fleet_snapshot()
+            verdict = None
+            if spec is not None:
+                from .. import slo as _slo
+                samples = state.request_samples()
+                if not any(samples.get(k) for k in
+                           ("ttft", "tpot", "queue_wait",
+                            "step_latency")):
+                    # no per-request rows scraped: latency objectives
+                    # fall back to the merged fleet histograms — but
+                    # the row-derived goodput ledger (training fleets
+                    # stream step rows without serving rows) must
+                    # survive the swap
+                    fallback = _slo.samples_from_metrics(snap)
+                    fallback["goodput"] = samples.get("goodput")
+                    samples = fallback
+                verdict = _slo.evaluate(spec, samples)
+            frame = render_frame(state, "fleet %s" % label,
+                                 slo_verdict=verdict,
+                                 now=None if once else time.time(),
+                                 fleet=snap)
+            if once:
+                from .metrics import META_KEY
+                eps = (snap.get(META_KEY) or {}).get("endpoints") or []
+                if not any(e.get("ok") for e in eps):
+                    # exit-code parity with file mode (--once on a
+                    # missing log returns None -> exit 1): a fleet
+                    # where NOTHING answered must not read as healthy
+                    out.write(frame + "\nwatch: no endpoint "
+                              "answered the scrape\n")
+                    return None
+                out.write(frame + "\n")
+                return frame
+            out.write("\x1b[2J\x1b[H" + frame + "\n")
+            out.flush()
+            frames += 1
+            if max_frames is not None and frames >= max_frames:
+                return frame
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return None
+    finally:
+        if own_col:
+            col.close()

@@ -41,6 +41,10 @@ zero new plumbing):
     CANC  name=<rid>                      ack/forget a delivered result
                                           (idempotent)
     STAT                                  replica load/health snapshot
+    METR / HLTH                           fleet telemetry scrape
+                                          (monitor/collector.py):
+                                          metrics-registry snapshot +
+                                          recorder delta / liveness
     CLKS / EXIT                           clock probe / shutdown
 
 Exactly-once contract: the Router assigns each accepted request a
@@ -83,7 +87,8 @@ import uuid
 
 from ..distributed import membership as _membership
 from ..distributed.membership import KVClient
-from ..distributed.rpc import _send_msg, _recv_msg, _clock_reply
+from ..distributed.rpc import (_send_msg, _recv_msg, _clock_reply,
+                               _metr_reply, _hlth_reply)
 from ..monitor import metrics as _metrics
 from ..monitor import runtime as _monrt
 from ..resilience import faults as _faults
@@ -101,8 +106,11 @@ REPLICA_ROLE = "replica"
 # reclaim the slot with its create-if-absent CAS, while a changed value
 # makes its next expect-guarded keepalive FAIL -> `lost` -> it stops
 # serving a slot it no longer holds (membership's split-brain guard,
-# reused as the eviction mechanism).
-EVICTED_PREFIX = "evicted:"
+# reused as the eviction mechanism). The marker itself is
+# registry-level protocol shared with every registry reader (the
+# monitor collector filters it during discovery), so it lives in
+# membership; re-exported here for the existing fleet API surface.
+EVICTED_PREFIX = _membership.EVICTED_PREFIX
 
 _REG = _metrics.registry()
 FLEET_REPLICAS = _REG.gauge(
@@ -341,6 +349,14 @@ class ReplicaServer:
                 "admissions": st["admissions"]}).encode())
         elif op == "CLKS":
             _clock_reply(sock)
+        elif op == "METR":
+            # fleet telemetry scrape — deliberately BEHIND _maybe_fault
+            # like every other verb: a wedged replica that stops
+            # answering METR is exactly the staleness a collector must
+            # see, not paper over
+            _metr_reply(sock, payload, role="replica")
+        elif op == "HLTH":
+            _hlth_reply(sock, role="replica")
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

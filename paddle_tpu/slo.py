@@ -28,6 +28,7 @@ Spec schema (JSON)::
         {"metric": "queue_wait",  "percentile": 0.95, "max_seconds": 0.25},
         {"metric": "step_latency","percentile": 0.95, "max_seconds": 0.1},
         {"metric": "kv_used_blocks", "max_value": 56},
+        {"metric": "goodput_fraction", "min_ratio": 0.7},
         {"metric": "error_rate",  "max_ratio": 0.001}
       ]
     }
@@ -37,6 +38,11 @@ Spec schema (JSON)::
 block count via ``max_value``; percentile defaults to 1.0 = the
 window's max). Only the row surfaces carry it (--log / watch); a
 metrics snapshot has no per-step series to gate.
+
+``goodput_fraction`` (ISSUE 11) gates the monitor.goodput wall-time
+attribution — productive seconds over measured wall — computed from
+the same recorder rows (a HIGHER-is-better objective: ``min_ratio``
+is the floor). Row surfaces only, like kv_used_blocks.
 
 An objective with NO samples fails (a run that measured nothing cannot
 claim an SLO was met) and says so in its reason. CLI::
@@ -118,6 +124,11 @@ def load_spec(source):
                 raise ValueError(
                     "objective %d percentile %r outside (0, 1]"
                     % (i, q))
+        elif metric == "goodput_fraction":
+            if not isinstance(obj.get("min_ratio"), (int, float)):
+                raise ValueError(
+                    "objective %d (goodput_fraction) needs numeric "
+                    "'min_ratio'" % i)
         elif metric in GAUGE_METRICS:
             if not isinstance(obj.get("max_value"), (int, float)):
                 raise ValueError(
@@ -134,7 +145,8 @@ def load_spec(source):
                 "error_rate)"
                 % (i, metric,
                    ", ".join(sorted(list(LATENCY_METRICS)
-                                    + list(GAUGE_METRICS)))))
+                                    + list(GAUGE_METRICS)
+                                    + ["goodput_fraction"]))))
     return spec
 
 
@@ -144,15 +156,32 @@ def _empty_samples(source):
     return {"source": source, "requests": 0, "errors": 0,
             "ttft": [], "tpot": [], "queue_wait": [],
             "step_latency": [], "kv_used_blocks": [],
-            "histograms": {}, "skipped": 0}
+            "goodput": None, "histograms": {}, "skipped": 0}
 
 
-def samples_from_events(events, source="events"):
+def samples_from_events(events, source="events",
+                        compute_goodput=True):
     """Exact per-request samples from an iterable of flight-recorder
     event dicts (``serving_request`` rows + ``serving_step`` dt) — the
     ONE rows->samples extraction, shared by the monitor-log surface
-    below and the watch dashboard's rolling-window verdict."""
+    below and the watch dashboard's rolling-window verdict.
+
+    ``compute_goodput=False`` skips the wall-time ledger: callers
+    whose event stream is NOT one process's full timeline (the watch
+    rolling window, a multi-log union) must supply their own
+    per-process rollup instead — a union-timeline ledger would
+    collapse concurrent replicas' intervals."""
     out = _empty_samples(source)
+    if compute_goodput:
+        # the goodput ledger reads the SAME rows (durations +
+        # recovery markers); its wall-time attribution backs the
+        # goodput_fraction objective on the row surfaces. Only this
+        # double-iteration needs the events materialized — the
+        # single-pass callers (watch's per-refresh window) keep
+        # streaming.
+        from .monitor import goodput as _goodput
+        events = list(events)
+        out["goodput"] = _goodput.ledger_from_events(events)
     for e in events:
         ev = e.get("ev")
         if ev == "serving_request":
@@ -184,14 +213,24 @@ def samples_from_monitor_log(paths):
     single replica's view). ``paths``: one path or a sequence."""
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
-    events, skipped = [], 0
+    per_file, events, skipped = [], [], 0
     for path in paths:
         evs, sk = read_jsonl_tolerant(path)
+        per_file.append(evs)
         events.extend(evs)
         skipped += sk
     out = samples_from_events(
         events, "monitor log%s %s" % ("s" if len(paths) > 1 else "",
-                                      ", ".join(map(str, paths))))
+                                      ", ".join(map(str, paths))),
+        compute_goodput=len(per_file) == 1)
+    if len(per_file) > 1:
+        # goodput must attribute each PROCESS's own wall clock: over
+        # the union timeline, two replicas' concurrent productive
+        # intervals would collapse into one (undercounting the fleet)
+        # — roll up per-file ledgers instead (Σ productive / Σ wall)
+        from .monitor import goodput as _goodput
+        out["goodput"] = _goodput.rollup(
+            _goodput.ledger_from_events(evs) for evs in per_file)
     out["skipped"] = skipped
     return out
 
@@ -290,6 +329,21 @@ def evaluate(spec, samples):
                             "reason": "no requests observed"})
             else:
                 ent["pass"] = measured <= threshold
+        elif metric == "goodput_fraction":
+            # higher-is-better ratio: the goodput ledger's productive
+            # share of measured wall time (monitor/goodput.py), rolled
+            # up per process on multi-log sources
+            threshold = float(obj["min_ratio"])
+            led = samples.get("goodput") or {}
+            measured = led.get("goodput_fraction")
+            ent = {"metric": metric, "threshold": threshold,
+                   "measured": measured,
+                   "count": led.get("rows", 0), "approximate": False}
+            if measured is None:
+                ent.update({"pass": False,
+                            "reason": "no timestamped rows observed"})
+            else:
+                ent["pass"] = measured >= threshold
         else:
             gauge = metric in GAUGE_METRICS
             q = float(obj.get("percentile", 1.0 if gauge else 0.95))
@@ -330,7 +384,7 @@ def evaluate(spec, samples):
 def _fmt(metric, v):
     if v is None:
         return "n/a"
-    if metric == "error_rate":
+    if metric in ("error_rate", "goodput_fraction"):
         return "%.2f%%" % (100.0 * v)
     if metric in GAUGE_METRICS:
         return "%g" % v
@@ -348,9 +402,10 @@ def render(verdict):
         label = r["metric"]
         if "percentile" in r:
             label += " p%g" % (100.0 * r["percentile"])
-        line = "  %-4s %-18s %9s <= %-9s (n=%d%s)" % (
+        cmp_ = ">=" if r["metric"] == "goodput_fraction" else "<="
+        line = "  %-4s %-18s %9s %s %-9s (n=%d%s)" % (
             "PASS" if r["pass"] else "FAIL", label,
-            _fmt(r["metric"], r["measured"]),
+            _fmt(r["metric"], r["measured"]), cmp_,
             _fmt(r["metric"], r["threshold"]), r["count"],
             ", approx" if r.get("approximate") else "")
         if r.get("reason"):

@@ -7,6 +7,7 @@ import os
 import tempfile
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu as fluid
@@ -43,6 +44,7 @@ def _train(build_net, passes, lr=0.01):
             float(np.mean(accs)))
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_image_classification_resnet():
     exe, images, predict, first, last, acc = _train(
         lambda img: resnet.resnet_cifar10(img, depth=20), passes=4)
@@ -69,6 +71,7 @@ def test_image_classification_resnet():
     np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)  # softmax
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_image_classification_vgg():
     # epoch-MEAN losses (single-batch endpoints are too noisy for VGG at
     # this scale); last epoch must beat the first on average

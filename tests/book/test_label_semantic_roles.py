@@ -5,6 +5,7 @@ dynamic LSTM -> CRF loss, with Viterbi decoding sharing the transition
 parameter."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu as fluid
@@ -43,6 +44,7 @@ def db_lstm(word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark):
     return feature_out
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_label_semantic_roles_crf_trains():
     names = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
              "verb", "mark"]

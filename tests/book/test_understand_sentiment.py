@@ -4,6 +4,7 @@ variant) — embedding -> fc -> dynamic LSTM -> sequence pools -> softmax
 classifier on imdb, trained to an accuracy threshold."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu as fluid
@@ -33,6 +34,7 @@ def stacked_lstm_net(data, label, input_dim, class_dim=2, emb_dim=32,
     return avg_cost, acc, prediction
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_understand_sentiment_stacked_lstm():
     data = fluid.layers.data("words", [1], dtype="int64", lod_level=1)
     label = fluid.layers.data("label", [1], dtype="int64")

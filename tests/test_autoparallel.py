@@ -208,6 +208,7 @@ def test_recommend_transformer_at_8_devices(tf_spec):
         assert ms == sorted(ms, reverse=True)
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_apply_top_plan_matches_handpicked_strategy(tf_spec):
     """ISSUE-9 acceptance: apply() of the planner's top recommendation
     runs under ParallelExecutor at 8 virtual devices, and its per-step

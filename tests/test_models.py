@@ -2,6 +2,7 @@
 train a few steps, assert loss decreases / shapes hold."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models import resnet, vgg, mlp
@@ -30,6 +31,7 @@ def test_resnet_cifar10_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_resnet50_imagenet_builds_and_runs():
     image, label, avg_cost, acc = resnet.build_train_net(
         model="resnet_imagenet", depth=50, image_shape=(3, 64, 64),
@@ -39,6 +41,7 @@ def test_resnet50_imagenet_builds_and_runs():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_vgg16_trains():
     image, label, avg_cost, acc = vgg.build_train_net(
         image_shape=(3, 32, 32), learning_rate=1e-3)

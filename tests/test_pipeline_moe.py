@@ -310,6 +310,7 @@ def _lm_parallel_loss(strategy, mesh_axes, prefix, num_experts=0):
                                       else None)
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_pipeline_composes_with_tp_and_sp():
     """pp x tp (Megatron shards + psum inside the stage) and pp x sp
     (ring attention inside the stage) match the pp-only run, which
@@ -346,6 +347,7 @@ def test_pipeline_interleaved_schedule_parity():
     np.testing.assert_allclose(w_i, w_g, rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_pipeline_full_composition_pp_tp_sp():
     """pp x tp x sp in ONE stage body: Megatron-sharded weights with
     per-sublayer psum AND ring attention over the sequence shard, inside
@@ -401,6 +403,7 @@ def test_pipeline_interleaved_with_recompute():
     np.testing.assert_allclose(w_ir, w_g, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_pipeline_composes_with_ep_moe():
     """pp x ep — the last composition refusal, lifted: MoE FFN inside
     the pipeline stage body, expert stacks sharded over ep with the
@@ -417,6 +420,7 @@ def test_pipeline_composes_with_ep_moe():
     np.testing.assert_allclose(w_ep, w_dense, rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow  # ISSUE-11 durations audit: >10 s on tier-1
 def test_pipeline_moe_interleaved_schedule():
     """pp x ep under the interleaved virtual-stage schedule (aux loss
     rides the live-tick mask through the V-lap tick loop)."""
