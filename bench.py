@@ -524,6 +524,169 @@ def main():
 
     fleet_summary = guarded("fleet-probe", fleet_probe, errors)
 
+    def recsys_probe():
+        """ISSUE-12 sparse-serving probe, CPU-pinned like the serving
+        probe: DeepFM scoring against live pserver row shards through
+        the serving.sparse tier. (a) COLD vs WARM hot-ID cache
+        scoring throughput, interleaved A/B windows (cold = cache
+        cleared before the window, every row over the PRFT wire; warm
+        = the zipf-hot id set served cacheside) + the final cache hit
+        rate; (b) routed-vs-direct overhead — the same request set
+        through KV registry + Router + scoring replica vs the direct
+        engine — with bitwise score identity verified at the pinned
+        cache version."""
+        import jax
+        import numpy as np
+        from paddle_tpu.distributed.membership import KVServer, KVClient
+        from paddle_tpu.distributed.rpc import VariableServer
+        from paddle_tpu.models import deepfm as dfm
+        from paddle_tpu.serving import fleet
+        from paddle_tpu.serving.sparse import (HotIDCache, SparseClient,
+                                               ScoringEngine)
+        prev = jax.config.jax_default_device
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        VOCAB, DIM, F, NSHARD = 20000, 16, 8, 2
+        servers, eps = [], []
+        closers = []
+        try:
+            _fresh()
+            rng = np.random.RandomState(0)
+            tables = {
+                "fm_first_w": rng.rand(VOCAB, 1).astype(np.float32),
+                "fm_second_w": rng.rand(VOCAB, DIM).astype(np.float32)}
+            for shard in range(NSHARD):
+                meta = {t: {"shard": shard, "num_shards": NSHARD,
+                            "height": VOCAB} for t in tables}
+                srv = VariableServer(fan_in=1, sparse_tables=meta)
+                for t, full in tables.items():
+                    srv.store[t] = full[shard::NSHARD].copy()
+                srv.start()
+                servers.append(srv)
+                eps.append("127.0.0.1:%d" % srv.port)
+
+            scope = fluid.global_scope()
+            prob, _ = dfm.build_scoring_net(F, DIM, dnn_dims=(32, 32))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            main = fluid.default_main_program()
+
+            def make_engine(name):
+                cache = HotIDCache(capacity=65536, staleness_s=60.0)
+                c1 = SparseClient("fm_first_w", eps, cache=cache)
+                c2 = SparseClient("fm_second_w", eps, cache=cache)
+                feat = dfm.make_featurizer(c1, c2, F, DIM)
+                eng = ScoringEngine(main, scope, prob.name, feat,
+                                    clients=[c1, c2], batch=8,
+                                    name=name)
+                closers.append(eng)
+                return eng
+
+            eng = make_engine("recsys-direct")
+            eng.warmup()
+            # zipf-hot traffic: the hot-ID cache's natural shape — a
+            # small head of ids dominates every batch
+            nreq = 64
+            hot = rng.randint(0, 256, (nreq, F))
+            tail = rng.randint(0, VOCAB, (nreq, F))
+            pick = rng.rand(nreq, F) < 0.9
+            ids = np.where(pick, hot, tail)
+            feats = [{"f%d" % f: [int(ids[r, f])] for f in range(F)}
+                     for r in range(nreq)]
+
+            def win_cold():
+                for c in eng._clients:
+                    c.cache.clear()
+                t0 = time.perf_counter()
+                eng.score_many(feats, timeout=120)
+                return nreq / (time.perf_counter() - t0)
+
+            def win_warm():
+                t0 = time.perf_counter()
+                eng.score_many(feats, timeout=120)
+                return nreq / (time.perf_counter() - t0)
+
+            win_cold(), win_warm()          # warm the compile + cache
+            cold, warm = [], []
+            for _ in range(3):              # interleaved A/B
+                cold.append(win_cold())
+                warm.append(win_warm())
+            mc, spc, _ = agg(cold, nd=0)
+            mw, spw, _ = agg(warm, nd=0)
+            cs = eng.cache_stats()
+            hit_rate = cs["hits"] / max(1, cs["hits"] + cs["misses"])
+
+            # routed-vs-direct at a pinned cache version (no online
+            # updates land during the A/B -> versions equal -> scores
+            # bitwise): interleaved windows, PR-8 protocol
+            kvs = KVServer(sweep_interval=0.05).start()
+            kv = KVClient(kvs.endpoint)
+            cell = fleet.Replica(kv, None, desired=1, ttl=0.5,
+                                 engine_factory=lambda name:
+                                 make_engine("recsys-replica"))
+            router = fleet.Router(kvs.endpoint, refresh_interval=0.05)
+            router.wait_for_replicas(1)
+
+            def win_direct():
+                t0 = time.perf_counter()
+                out = eng.score_many(feats, timeout=120)
+                return time.perf_counter() - t0, out
+
+            def win_routed():
+                t0 = time.perf_counter()
+                hs = [router.submit(features=f) for f in feats]
+                out = [h.result(timeout=120)[1] for h in hs]
+                return time.perf_counter() - t0, out
+
+            win_direct(), win_routed()      # warm the replica's cache
+            a_dt, b_dt, identical = [], [], True
+            for _ in range(3):
+                dt, base = win_direct()
+                a_dt.append(dt)
+                dt, routed = win_routed()
+                b_dt.append(dt)
+                identical = identical and routed == base
+            ma, spa, _ = agg(a_dt, nd=4)
+            mb, spb, _ = agg(b_dt, nd=4)
+            probe = {
+                "config": "deepfm F8 D16 V20k, 2 pserver shards, 64 "
+                          "zipf-hot reqs, batch=8 (CPU pin)",
+                "windows": 3,
+                "cold_rps": round(mc), "cold_spread_pct": spc,
+                "warm_rps": round(mw), "warm_spread_pct": spw,
+                "warm_over_cold": round(mw / mc, 2),
+                "cache_hit_rate": round(hit_rate, 3),
+                "wire_rows": sum(c.stats["wire_rows"]
+                                 for c in eng._clients),
+                "miss_row_us": round(1e6 * (
+                    eng._clients[0].miss_row_seconds() or 0), 1),
+                "direct_s": round(ma, 4), "direct_spread_pct": spa,
+                "routed_s": round(mb, 4), "routed_spread_pct": spb,
+                "router_overhead_pct": round(100 * (mb - ma) / ma, 2),
+                "identical": bool(identical),
+            }
+            router.close()
+            cell.shutdown()
+            kv.shutdown_server()
+            kv.close()
+            print("recsys probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            for eng in closers:
+                try:
+                    eng.close()
+                    for c in eng._clients:
+                        c.close()
+                except Exception:
+                    pass
+            for srv in servers:
+                try:
+                    srv.stop()
+                except Exception:
+                    pass
+            jax.config.update("jax_default_device", prev)
+
+    recsys_summary = guarded("recsys-probe", recsys_probe, errors)
+
     def transform_probe():
         """ISSUE-9 transform probe, CPU-pinned like the serving probe:
         (a) the optimizing pass pipeline over the Program zoo (rewrite
@@ -683,6 +846,12 @@ def main():
         # latency) + the armed kill pass's resubmission/exactly-once
         # verdict
         out["fleet"] = fleet_summary
+    if recsys_summary is not None:
+        # sparse-serving stamp (ISSUE 12): cold-vs-warm hot-ID cache
+        # scoring throughput A/B, final cache hit rate, measured
+        # miss-path cost, and routed-vs-direct overhead with the
+        # bitwise score-identity verdict at a pinned cache version
+        out["recsys"] = recsys_summary
     try:
         # platform stamp: a chipless (CPU-pinned) rehearsal round must
         # never be read as a chip round's throughput record

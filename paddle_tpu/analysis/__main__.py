@@ -28,6 +28,10 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.serving.fleet",
     "paddle_tpu.serving.kvpool",
     "paddle_tpu.serving.sampling",
+    "paddle_tpu.serving.sparse",
+    "paddle_tpu.serving.sparse.cache",
+    "paddle_tpu.serving.sparse.scoring",
+    "paddle_tpu.serving.sparse.online",
     "paddle_tpu.reader",
     "paddle_tpu.reader.device_loader",
     "paddle_tpu.slo",

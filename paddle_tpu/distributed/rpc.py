@@ -407,6 +407,13 @@ class VariableServer:
                  optimize_fn=None, port_file=None, sync=True,
                  sparse_tables=None):
         self.store = {}              # name -> np.ndarray
+        # per-process-lifetime identity: a REPLACEMENT server recovered
+        # from checkpoint restores the same round counter, so readers
+        # that cache rows (serving.sparse hot-ID cache) key their
+        # invalidation on this token — an incarnation bump means every
+        # cached row from the dead server is suspect, round number
+        # notwithstanding
+        self.incarnation = uuid.uuid4().hex[:12]
         self.grads = {}              # name -> list of pending grads
         self.fan_in = fan_in
         self.optimize_fn = optimize_fn
@@ -426,10 +433,20 @@ class VariableServer:
         self._pending_chunks = {}    # tid -> chunk-parallel push parts
         self._round = 0
         self._shutdown = threading.Event()
+        # accepted connections, tracked so stop() can SEVER them: a
+        # dying process resets every socket it holds, but an in-process
+        # stop() would otherwise leave handler threads parked in recv
+        # serving the dead store — exactly the zombie a client-side
+        # resolver could never notice (serving.sparse's stale-forever
+        # hazard). Closing them makes stop() look like process death
+        # from every peer's side.
+        self._conns = set()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                with outer._lock:
+                    outer._conns.add(self.request)
                 try:
                     while True:
                         op, nlen, plen, tctx = _recv_frame_head(
@@ -459,6 +476,9 @@ class VariableServer:
                             break
                 except (ConnectionError, OSError):
                     pass
+                finally:
+                    with outer._lock:
+                        outer._conns.discard(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -492,6 +512,22 @@ class VariableServer:
         if self._thread.is_alive():
             self._server.shutdown()
         self._server.server_close()
+        # sever accepted connections (see _conns above): peers see the
+        # same connection reset a real process death gives them, so
+        # their retry/resolver recovery path engages instead of a
+        # zombie handler thread serving the dead store forever
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     # -- dispatch ------------------------------------------------------------
     def _prune_chunks_locked(self, now):
@@ -615,6 +651,15 @@ class VariableServer:
             with self._lock:
                 table = self.store.get(name)
                 meta = self.sparse_tables.get(name)
+                rnd = self._round
+            # the reply NAME carries the rows' version coordinates
+            # ("<table>|v<round>|<incarnation>") so a caching reader
+            # (serving.sparse) can bound staleness: the round bumps
+            # once per applied optimize round, the incarnation changes
+            # when a replacement server recovers from checkpoint. Old
+            # clients ignore the reply name entirely — the payload is
+            # byte-identical to the unversioned reply.
+            ver = "%s|v%d|%s" % (name, rnd, self.incarnation)
             if table is None:
                 _send_msg(sock, "MISS", name)
             elif meta is not None:
@@ -623,13 +668,13 @@ class VariableServer:
                 local = ids // int(meta["num_shards"])
                 rows = np.asarray(table)[np.clip(local, 0,
                                                  len(table) - 1)]
-                _send_msg(sock, "VAL", name,
+                _send_msg(sock, "VAL", ver,
                           _serialize_parts(SelectedRows(
                               ids, rows, int(meta["height"]))))
             else:
                 rows = np.asarray(table)[np.clip(ids, 0,
                                                  len(table) - 1)]
-                _send_msg(sock, "VAL", name,
+                _send_msg(sock, "VAL", ver,
                           _serialize_parts(SelectedRows(ids, rows,
                                                         len(table))))
         elif op == "PUT":
@@ -1090,14 +1135,30 @@ class RPCClient:
     def put_var(self, name, value):
         self._push_value("PUT", name, value)
 
-    def prefetch(self, table_name, ids):
+    def prefetch(self, table_name, ids, want_version=False):
+        """Fetch rows by id. ``want_version=True`` additionally returns
+        the server's version coordinates parsed from the reply name —
+        ``{"round": <optimize rounds applied>, "inc": <server
+        incarnation>}``, or None against a pre-versioning server — the
+        token serving.sparse's hot-ID cache keys bounded staleness and
+        respawn invalidation on."""
         def body():
             _send_msg(self._sock, "PRFT", table_name,
                       serialize_var(np.asarray(ids, np.int64)))
-            op, _, payload = _recv_msg(self._sock)
+            op, name, payload = _recv_msg(self._sock)
             if op == "MISS":
                 raise KeyError("server has no table %r" % table_name)
-            return deserialize_var(payload)
+            sr = deserialize_var(payload)
+            if not want_version:
+                return sr
+            ver = None
+            parts = name.split("|")
+            if len(parts) == 3 and parts[1][:1] == "v":
+                try:
+                    ver = {"round": int(parts[1][1:]), "inc": parts[2]}
+                except ValueError:
+                    pass
+            return sr, ver
         return self._retrying("rpc.prefetch", True, body)
 
     def barrier(self, tag=None):

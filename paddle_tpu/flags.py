@@ -230,6 +230,20 @@ _register("serving_fleet_queue", int, 64,
           "once this many requests await dispatch, submit() fast-fails "
           "with the typed Overloaded error, counted against the SLO "
           "error budget")
+_register("serving_sparse_staleness_s", float, 5.0,
+          "serving.sparse hot-ID cache bounded-staleness window "
+          "(seconds): a cached embedding row older than this "
+          "re-fetches from its pserver shard on next touch — the "
+          "upper bound on how long an online update can stay "
+          "invisible through the cache (an observed version bump or "
+          "incarnation change invalidates sooner)")
+_register("serving_sparse_cache_rows", int, 65536,
+          "serving.sparse hot-ID cache capacity in ROWS (LRU): the "
+          "per-process bound on cached embedding rows across tables")
+_register("serving_scoring_batch", int, 8,
+          "serving.sparse ScoringEngine batch capacity: requests "
+          "scored per compiled dispatch (short batches pad to this "
+          "shape, so the compiled program never re-traces)")
 _register("serving_fleet_stall_timeout", float, 2.0,
           "serving.fleet Router response-deadline watchdog: a replica "
           "that answers no verb for this long (retry deadline "

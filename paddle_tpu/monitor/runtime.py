@@ -36,8 +36,9 @@ __all__ = [
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
     "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
     "on_checkpoint", "on_serving_step", "on_serving_request",
-    "on_feed_plan", "on_megastep", "on_transform", "summary",
-    "session", "prometheus_text", "dump_metrics",
+    "on_feed_plan", "on_megastep", "on_transform", "on_sparse_lookup",
+    "on_sparse_evictions", "on_sparse_prefetch", "on_sparse_staleness",
+    "summary", "session", "prometheus_text", "dump_metrics",
 ]
 
 _REG = _metrics.registry()
@@ -195,6 +196,35 @@ TRANSFORM_PASSES = _REG.counter(
 TRANSFORM_OPS_REMOVED = _REG.counter(
     "ptpu_transform_ops_removed_total",
     "ops removed or rewritten by an optimizing pass", ("pass",))
+# sparse serving tier (paddle_tpu.serving.sparse, ISSUE 12): the hot-ID
+# embedding cache in front of the live pserver shards, and the online-
+# learning loop's read-your-writes staleness. Counters tick
+# unconditionally (a dict probe is nothing next to a PRFT round trip);
+# the staleness histogram backs the SLO `staleness_s` objective from a
+# metrics snapshot the same way the latency histograms back TTFT
+SPARSE_CACHE_HITS = _REG.counter(
+    "ptpu_sparse_cache_hits_total",
+    "embedding rows served from the hot-ID cache (no wire)")
+SPARSE_CACHE_MISSES = _REG.counter(
+    "ptpu_sparse_cache_misses_total",
+    "embedding rows fetched from a pserver shard (cache cold)")
+SPARSE_CACHE_STALE = _REG.counter(
+    "ptpu_sparse_cache_stale_total",
+    "cached rows re-fetched because they aged past the staleness "
+    "bound or their shard's version/incarnation moved")
+SPARSE_CACHE_EVICTIONS = _REG.counter(
+    "ptpu_sparse_cache_evictions_total",
+    "hot-ID cache rows evicted (LRU capacity or shard invalidation)")
+SPARSE_PREFETCH_ROWS = _REG.counter(
+    "ptpu_sparse_prefetch_rows_total",
+    "embedding rows pulled over the PRFT wire (deduplicated, batched)")
+SPARSE_PREFETCH_BYTES = _REG.counter(
+    "ptpu_sparse_prefetch_bytes_total",
+    "embedding row payload bytes pulled over the PRFT wire")
+SPARSE_STALENESS = _REG.histogram(
+    "ptpu_sparse_staleness_seconds",
+    "read-your-writes staleness: an online update landing on the "
+    "pservers -> the first serve whose rows reflect it", ("table",))
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -738,7 +768,9 @@ def on_checkpoint(step, path, mode):
 def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
                     retired=0, engine="engine", dt=None, k=1,
                     dispatched=None, kv_used=None, kv_total=None,
-                    prefix_hits=None, prefix_misses=None, preempted=0):
+                    prefix_hits=None, prefix_misses=None, preempted=0,
+                    cache_hits=None, cache_misses=None,
+                    cache_stale=None, cache_evictions=None):
     """One engine iteration completed: gauges reflect the step, counters
     accumulate, and (recorder armed) a ``serving_step`` row lands with
     the step wall time and the active trace id so the fleet timeline
@@ -793,6 +825,15 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
             extra["prefix_misses"] = prefix_misses
             if preempted:
                 extra["preempted"] = preempted
+        if cache_hits is not None:
+            # sparse scoring engines (serving.sparse): CUMULATIVE
+            # hot-ID cache counters on every row, same discipline as
+            # the prefix counters — a window's hit rate is last-row
+            # arithmetic, never a sum
+            extra["cache_hits"] = cache_hits
+            extra["cache_misses"] = cache_misses
+            extra["cache_stale"] = cache_stale
+            extra["cache_evictions"] = cache_evictions
         rec.record("serving_step", engine=engine, active=active,
                    slots=slots, queue_depth=queue_depth,
                    emitted=emitted, admitted=admitted, retired=retired,
@@ -808,6 +849,47 @@ def on_prefix_evictions(n=1):
     """Prefix-cache blocks LRU-freed under pool pressure."""
     if n:
         PREFIX_EVICTIONS.inc(n)
+
+
+# -- sparse serving hooks (paddle_tpu.serving.sparse, ISSUE 12) ------------
+
+def on_sparse_lookup(hits=0, misses=0, stale=0):
+    """One batched hot-ID cache lookup resolved: ``hits`` rows served
+    cacheside, ``misses`` fetched cold, ``stale`` re-fetched past the
+    staleness bound / version bump (stale rows also count as misses on
+    the wire — the counters answer different questions and are not
+    meant to sum to the row count)."""
+    if hits:
+        SPARSE_CACHE_HITS.inc(hits)
+    if misses:
+        SPARSE_CACHE_MISSES.inc(misses)
+    if stale:
+        SPARSE_CACHE_STALE.inc(stale)
+
+
+def on_sparse_evictions(n=1):
+    if n:
+        SPARSE_CACHE_EVICTIONS.inc(n)
+
+
+def on_sparse_prefetch(rows, nbytes):
+    """One batched PRFT pull against a pserver shard completed."""
+    if rows:
+        SPARSE_PREFETCH_ROWS.inc(rows)
+    if nbytes:
+        SPARSE_PREFETCH_BYTES.inc(nbytes)
+
+
+def on_sparse_staleness(seconds, table="table"):
+    """One measured read-your-writes staleness sample (online update
+    landed -> first serve reflecting it). Observes the histogram and —
+    recorder armed — lands a ``sparse_staleness`` row, the sample the
+    SLO ``staleness_s`` objective gates on the --log surface."""
+    SPARSE_STALENESS.observe(float(seconds), table=table)
+    rec = _S.rec
+    if rec is not None:
+        rec.record("sparse_staleness", value=float(seconds),
+                   table=table, **_trace_extra())
 
 
 def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,

@@ -256,6 +256,21 @@ def fleet_lines(fleet_snap, now=None):
         "errors %d   ps rounds %d" % (steps or 0, tokens or 0,
                                       reqs or 0, errs or 0,
                                       rounds or 0))
+    sp_h = _fleet_counter(fleet_snap, "ptpu_sparse_cache_hits_total")
+    sp_m = _fleet_counter(fleet_snap, "ptpu_sparse_cache_misses_total")
+    if sp_h is not None or sp_m is not None:
+        # sparse serving tier present (serving.sparse): the merged
+        # hot-ID cache view — exact sums across every scraped process
+        sp_h, sp_m = sp_h or 0, sp_m or 0
+        sp_s = _fleet_counter(
+            fleet_snap, "ptpu_sparse_cache_stale_total") or 0
+        sp_r = _fleet_counter(
+            fleet_snap, "ptpu_sparse_prefetch_rows_total") or 0
+        rate = "n/a" if sp_h + sp_m == 0 \
+            else "%.0f%%" % (100.0 * sp_h / (sp_h + sp_m))
+        lines.append(
+            "  sparse   cache hits %d misses %d stale %d (hit rate "
+            "%s)   prefetch rows %d" % (sp_h, sp_m, sp_s, rate, sp_r))
     return lines
 
 
@@ -320,6 +335,23 @@ def render_frame(state, path, slo_verdict=None, now=None,
             "misses %d (hit rate %s)   preemptions %d"
             % (used, total, 100.0 * used / total if total else 0.0,
                h, m, rate, state.total_preemptions))
+    sparse_last = {}
+    for s in state.serving_steps:
+        if s.get("cache_hits") is not None:
+            # hot-ID cache counters are CUMULATIVE per engine row
+            # (serving.sparse scoring engines) — last row per engine,
+            # same discipline as the kv line above
+            sparse_last[s.get("engine") or "engine"] = s
+    if sparse_last:
+        rows = list(sparse_last.values())
+        h = sum(r.get("cache_hits") or 0 for r in rows)
+        m = sum(r.get("cache_misses") or 0 for r in rows)
+        st = sum(r.get("cache_stale") or 0 for r in rows)
+        ev = sum(r.get("cache_evictions") or 0 for r in rows)
+        rate = "n/a" if h + m == 0 else "%.0f%%" % (100.0 * h / (h + m))
+        lines.append(
+            "sparse    cache hits %d misses %d stale %d evictions %d "
+            "(hit rate %s)" % (h, m, st, ev, rate))
     if state.requests:
         # failed rows are error-budget-only (same policy as the SLO
         # engine — this line and the verdict line below must agree)

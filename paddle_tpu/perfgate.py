@@ -104,6 +104,17 @@ PROBES = (
     Probe("fleet_router_overhead_pct",
           ("fleet", "router_overhead_pct"), "lower", 15.0,
           band_abs=10.0),
+    # recsys sparse-serving probe (ISSUE 12): warm-cache scoring
+    # throughput + the warm/cold ratio the hot-ID cache buys, plus
+    # the routed-vs-direct front-door overhead (pct points around
+    # zero -> absolute band, like the fleet probe)
+    Probe("recsys_warm_rps", ("recsys", "warm_rps"), "higher", 30.0,
+          ("recsys", "warm_spread_pct")),
+    Probe("recsys_warm_over_cold", ("recsys", "warm_over_cold"),
+          "higher", 25.0),
+    Probe("recsys_router_overhead_pct",
+          ("recsys", "router_overhead_pct"), "lower", 15.0,
+          band_abs=10.0),
 )
 
 

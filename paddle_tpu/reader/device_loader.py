@@ -43,7 +43,9 @@ class DeviceLoader:
     executor's own pass can deliver, so the loader neither pre-splits
     nor uploads them (uploading ``np.asarray(lod_tensor)`` would
     silently strip the LoD — the pre-ISSUE-7 behavior). A batch mixing
-    dense and LoD feeds still prefetches its dense values."""
+    dense and LoD feeds still prefetches its dense values, and (since
+    ISSUE 12) the dense subset rides the plan cache too — only the LoD
+    values take the executor-side normalization fallback."""
 
     def __init__(self, feed_iterable, capacity=2, device=None,
                  sharding=None, plan_cache=None):
@@ -86,15 +88,32 @@ class DeviceLoader:
         feeds pass through untouched — their flat/bucketed form carries
         trace-time static_info only the executor's own normalization
         pass can deliver, so pre-splitting them here would change what
-        the compiled step sees."""
+        the compiled step sees.
+
+        A batch MIXING dense and LoD feeds (the shape a recsys scoring
+        pipeline produces: ragged sparse-ID lists next to dense
+        features) previously bypassed the plan cache WHOLESALE — every
+        dense value re-derived its normalization per batch. Now the
+        dense subset rides its own cached plan (keyed by the subset's
+        signature) and only the LoD values take the documented
+        executor-side fallback."""
         if self._plans is None:
             return feed
         from ..core.lod import LoDTensor
-        if any(isinstance(v, LoDTensor) for v in feed.values()):
+        lod = {k: v for k, v in feed.items()
+               if isinstance(v, LoDTensor)}
+        if not lod:
+            from ..core.executor import _normalize_feeds
+            arrays, _ = _normalize_feeds(feed, plan_cache=self._plans)
+            return arrays
+        dense = {k: v for k, v in feed.items() if k not in lod}
+        if not dense:
             return feed
         from ..core.executor import _normalize_feeds
-        arrays, _ = _normalize_feeds(feed, plan_cache=self._plans)
-        return arrays
+        arrays, _ = _normalize_feeds(dense, plan_cache=self._plans)
+        out = dict(arrays)
+        out.update(lod)
+        return out
 
     def _stage(self, feed):
         """One prefetched batch → device (dense values) / host

@@ -273,8 +273,15 @@ class ReplicaServer:
             if req._error is not None:
                 done.append({"id": rid, "error": repr(req._error)})
             else:
-                done.append({"id": rid, "tokens": list(req.tokens),
-                             "score": req.score})
+                ent = {"id": rid, "tokens": list(req.tokens),
+                       "score": req.score}
+                ver = getattr(req, "versions", None)
+                if ver is not None:
+                    # scoring result: the served cache-version
+                    # coordinates travel with the score so the caller
+                    # can check its pin (determinism contract)
+                    ent["versions"] = ver
+                done.append(ent)
             if len(done) >= cap:
                 break
         return done
@@ -293,16 +300,29 @@ class ReplicaServer:
                 self._prune_locked(time.time())
                 if name not in self._jobs:
                     try:
-                        req = self.engine.submit(
-                            body["prompt"], body["max_new"],
-                            request_id=name,
-                            sampling=body.get("sampling"))
-                    except ValueError as e:
+                        if "features" in body:
+                            # scoring payload (serving.sparse): the
+                            # replica fronts a ScoringEngine — same
+                            # journal/dedup/delivery machinery, the
+                            # score rides the result wire's "score"
+                            # with empty tokens
+                            req = self.engine.submit(
+                                body["features"], request_id=name,
+                                version_pin=body.get("version_pin"))
+                        else:
+                            req = self.engine.submit(
+                                body["prompt"], body["max_new"],
+                                request_id=name,
+                                sampling=body.get("sampling"))
+                    except (ValueError, TypeError) as e:
                         # invalid request (e.g. prompt + max_new past
-                        # the model's max_len): a typed reply — NOT a
-                        # torn connection — so the router fails it
-                        # terminally instead of retrying it into every
-                        # replica in turn
+                        # the model's max_len — ValueError) or a
+                        # WORKLOAD mismatch (TypeError: a scoring
+                        # payload reaching a decode engine in a fleet
+                        # that mixed replica kinds under one role):
+                        # a typed reply — NOT a torn connection — so
+                        # the router fails it terminally instead of
+                        # retrying it into every replica in turn
                         _send_msg(sock, "BADR", name, repr(e).encode())
                         return
                     except RuntimeError as e:
@@ -373,10 +393,18 @@ class Replica:
     token-identically — greedy decode is deterministic."""
 
     def __init__(self, kv, model, desired, slots=2, ttl=0.5,
-                 role=REPLICA_ROLE, name=None, **engine_kwargs):
+                 role=REPLICA_ROLE, name=None, engine_factory=None,
+                 **engine_kwargs):
         self.name = name or ("replica-" + uuid.uuid4().hex[:6])
-        self.engine = Engine(model, slots=slots, name=self.name,
-                             **engine_kwargs)
+        if engine_factory is not None:
+            # non-decode cells (serving.sparse ScoringEngine): the
+            # factory builds anything speaking the Engine protocol
+            # (submit/close/stats/slots/on_retire) — the RPC front,
+            # lease, journal and router machinery are workload-blind
+            self.engine = engine_factory(self.name)
+        else:
+            self.engine = Engine(model, slots=slots, name=self.name,
+                                 **engine_kwargs)
         self.server = ReplicaServer(self.engine, on_crash=self.crash)
         self.endpoint = self.server.endpoint
         try:
@@ -479,12 +507,19 @@ class ReplicaClient:
             attempt, what=what, retry_on=RETRYABLE,
             on_retry=lambda a, e: self._drop_conn())
 
-    def submit(self, rid, prompt, max_new, sampling=None):
+    def submit(self, rid, prompt, max_new, sampling=None,
+               features=None, version_pin=None):
         def body():
-            wire = {"prompt": [int(t) for t in prompt],
-                    "max_new": int(max_new)}
-            if sampling is not None:
-                wire["sampling"] = sampling
+            if features is not None:
+                # scoring payload (serving.sparse ScoringEngine)
+                wire = {"features": features}
+                if version_pin is not None:
+                    wire["version_pin"] = version_pin
+            else:
+                wire = {"prompt": [int(t) for t in prompt],
+                        "max_new": int(max_new)}
+                if sampling is not None:
+                    wire["sampling"] = sampling
             _send_msg(self._sock, "SUBM", rid, json.dumps(wire).encode())
             op, _, payload = _recv_msg(self._sock)
             if op == "BADR":
@@ -550,16 +585,18 @@ class FleetRequest:
     submit time instead — shed requests never get a handle)."""
 
     __slots__ = ("rid", "prompt", "max_new", "session", "sampling",
-                 "tokens", "score", "resubmits", "t_submit", "t_done",
-                 "_event", "_error")
+                 "features", "versions", "tokens", "score",
+                 "resubmits", "t_submit", "t_done", "_event", "_error")
 
     def __init__(self, rid, prompt, max_new, session=None,
-                 sampling=None):
+                 sampling=None, features=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.session = session
         self.sampling = sampling
+        self.features = features   # scoring payload (serving.sparse)
+        self.versions = None       # served cache version (scoring)
         self.tokens = None
         self.score = None
         self.resubmits = 0
@@ -683,8 +720,8 @@ class Router:
             t.start()
 
     # -- public API --------------------------------------------------------
-    def submit(self, prompt, max_new_tokens, session=None,
-               sampling=None):
+    def submit(self, prompt=None, max_new_tokens=None, session=None,
+               sampling=None, features=None, version_pin=None):
         """Accept one request (returns its FleetRequest handle), or
         fast-fail with the typed ``Overloaded`` error once the global
         queue bound is hit — shed requests are counted against the SLO
@@ -693,9 +730,29 @@ class Router:
         request, so an at-least-once re-dispatch to a survivor replica
         re-executes with the SAME params + seed — deterministic
         counter-keyed sampling keeps the exactly-once dedup valid for
-        stochastic traffic too."""
-        prompt = [int(t) for t in prompt]
-        max_new = int(max_new_tokens)
+        stochastic traffic too.
+
+        ``features`` (serving.sparse): a SCORING payload instead of a
+        decode one — dict of field -> ragged id list for a replica
+        fronting a ``ScoringEngine``. Journaled + resubmitted exactly
+        like decode work (scoring is deterministic at a pinned cache
+        version, so re-execution composes with the dedup); the score
+        arrives on the handle's ``score`` with empty ``tokens``, the
+        served cache version on ``versions``."""
+        if features is not None:
+            prompt, max_new = [], 0
+            if isinstance(features, dict):
+                # normalize to wire-safe plain types ONCE at the front
+                # door (numpy ints/arrays in id lists would otherwise
+                # die inside dispatch's json.dumps with an opaque
+                # terminal error a direct ScoringEngine accepts fine)
+                features = json.loads(json.dumps(
+                    features,
+                    default=lambda o: o.tolist()
+                    if hasattr(o, "tolist") else repr(o)))
+        else:
+            prompt = [int(t) for t in prompt]
+            max_new = int(max_new_tokens)
         if sampling is not None and not isinstance(sampling, dict):
             sampling = sampling.to_dict()      # SamplingParams → wire
         with self._cv:
@@ -720,10 +777,11 @@ class Router:
                 self._sweep_journal_locked()
             rid = "%s-%06d" % (self._id, next(self._seq))
             handle = FleetRequest(rid, prompt, max_new, session=session,
-                                  sampling=sampling)
+                                  sampling=sampling, features=features)
             self._journal[rid] = {
                 "rid": rid, "prompt": prompt, "max_new": max_new,
                 "session": session, "sampling": sampling,
+                "features": features, "version_pin": version_pin,
                 "state": _QUEUED, "replica": None,
                 "attempts": 0, "handle": handle,
             }
@@ -855,6 +913,8 @@ class Router:
             h = entry["handle"]
             h.tokens = list(res["tokens"])
             h.score = res["score"]
+            if res.get("versions") is not None:
+                h.versions = res["versions"]   # scoring cache version
             h.resubmits = max(0, entry["attempts"] - 1)
             h.t_done = time.perf_counter()
             h._event.set()
@@ -1002,9 +1062,11 @@ class Router:
                 with _trace.span("router.dispatch", rid=rid, slot=slot,
                                  endpoint=info["endpoint"],
                                  attempt=entry["attempts"]):
-                    info["client"].submit(rid, entry["prompt"],
-                                          entry["max_new"],
-                                          entry.get("sampling"))
+                    info["client"].submit(
+                        rid, entry["prompt"], entry["max_new"],
+                        entry.get("sampling"),
+                        features=entry.get("features"),
+                        version_pin=entry.get("version_pin"))
             except RETRYABLE:
                 self._replica_down(slot, info["endpoint"], "dispatch")
             except Exception as e:

@@ -28,10 +28,17 @@ Spec schema (JSON)::
         {"metric": "queue_wait",  "percentile": 0.95, "max_seconds": 0.25},
         {"metric": "step_latency","percentile": 0.95, "max_seconds": 0.1},
         {"metric": "kv_used_blocks", "max_value": 56},
+        {"metric": "staleness_s", "percentile": 0.95, "max_seconds": 6},
         {"metric": "goodput_fraction", "min_ratio": 0.7},
         {"metric": "error_rate",  "max_ratio": 0.001}
       ]
     }
+
+``staleness_s`` (ISSUE 12) gates the sparse serving tier's measured
+read-your-writes staleness (online update landed -> first serve
+reflecting it): exact samples from ``sparse_staleness`` recorder rows
+on the --log surface, bucket-interpolated from the
+``ptpu_sparse_staleness_seconds`` histogram on --metrics.
 
 ``kv_used_blocks`` (ISSUE 10) gates paged-KV pool pressure from the
 ``serving_step`` rows' per-iteration occupancy (threshold is a plain
@@ -83,6 +90,12 @@ LATENCY_METRICS = {
     "tpot": "ptpu_serving_tpot_seconds",
     "queue_wait": "ptpu_serving_queue_wait_seconds",
     "step_latency": "ptpu_serving_step_seconds",
+    # read-your-writes staleness of the sparse serving tier (ISSUE
+    # 12): an online update landing on the pservers -> the first
+    # serve reflecting it, measured end-to-end by
+    # serving.sparse.measure_staleness (sparse_staleness recorder
+    # rows / the ptpu_sparse_staleness_seconds histogram)
+    "staleness_s": "ptpu_sparse_staleness_seconds",
 }
 
 # gauge-valued objectives (thresholds are plain values, not seconds):
@@ -156,6 +169,7 @@ def _empty_samples(source):
     return {"source": source, "requests": 0, "errors": 0,
             "ttft": [], "tpot": [], "queue_wait": [],
             "step_latency": [], "kv_used_blocks": [],
+            "staleness_s": [],
             "goodput": None, "histograms": {}, "skipped": 0}
 
 
@@ -202,6 +216,9 @@ def samples_from_events(events, source="events",
             if e.get("kv_used_blocks") is not None:
                 out["kv_used_blocks"].append(
                     float(e["kv_used_blocks"]))
+        elif ev == "sparse_staleness":
+            if e.get("value") is not None:
+                out["staleness_s"].append(float(e["value"]))
     return out
 
 

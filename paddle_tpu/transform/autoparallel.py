@@ -401,34 +401,55 @@ def apply(plan, model, devices=None):
 
 # -- pserver embedding placement (DCN tier) --------------------------------
 
-def embedding_wire_costs(rows, dim, touched_rows, dtype_bytes=4):
+def embedding_wire_costs(rows, dim, touched_rows, dtype_bytes=4,
+                         measured_sparse_row_s=None):
     """Per-step DCN wire seconds for a pserver-sharded embedding,
     dense vs sparse. Dense ships the WHOLE table both ways every step
     (grad push + param pull — PERF.md round 3 measured ~105 MB
     wire/step for the 52 MB table); sparse ships only the touched rows
-    plus their int64 ids (the measured 131 KB/step shape)."""
+    plus their int64 ids (the measured 131 KB/step shape).
+
+    ``measured_sparse_row_s`` (ISSUE 12 placement pricing hook): a
+    LIVE per-row miss-path measurement —
+    ``serving.sparse.SparseClient.miss_row_seconds()`` — overrides the
+    modeled sparse wire term, so a serving deployment prices placement
+    with ITS wire (loopback, DCN, the axon tunnel) instead of the
+    PERF.md round-3 constants. The cost carries a
+    ``sparse_measured`` marker so rankings say which model priced
+    them."""
     rows, dim = int(rows), int(dim)
     touched = min(int(touched_rows), rows)
     dense_bytes = float(rows) * dim * dtype_bytes
     sparse_bytes = float(touched) * (dim * dtype_bytes
                                      + DCN_SPARSE_ROW_OVERHEAD)
+    sparse_s = (sparse_bytes / DCN_DENSE_PUSH_BPS
+                + sparse_bytes / DCN_DENSE_PULL_BPS)
+    measured = measured_sparse_row_s is not None
+    if measured:
+        sparse_s = float(touched) * float(measured_sparse_row_s)
     return {
         "dense": (dense_bytes / DCN_DENSE_PUSH_BPS
                   + dense_bytes / DCN_DENSE_PULL_BPS),
-        "sparse": (sparse_bytes / DCN_DENSE_PUSH_BPS
-                   + sparse_bytes / DCN_DENSE_PULL_BPS),
+        "sparse": sparse_s,
+        "sparse_measured": measured,
         "dense_wire_bytes": 2.0 * dense_bytes,
         "sparse_wire_bytes": 2.0 * sparse_bytes,
     }
 
 
 def recommend_embedding_placement(rows, dim, touched_rows,
-                                  dtype_bytes=4):
+                                  dtype_bytes=4,
+                                  measured_sparse_row_s=None):
     """[(mode, cost_seconds)] cheapest first for a pserver-sharded
     embedding shape. Pinned against PERF.md: the [200k x 64] table with
     a few hundred touched rows/step ranks sparse over dense (measured
-    7046 vs 335 samples/s)."""
-    costs = embedding_wire_costs(rows, dim, touched_rows, dtype_bytes)
+    7046 vs 335 samples/s). Pass a serving SparseClient's
+    ``miss_row_seconds()`` as ``measured_sparse_row_s`` to rank with
+    the deployment's own measured miss path instead of the modeled
+    wire."""
+    costs = embedding_wire_costs(
+        rows, dim, touched_rows, dtype_bytes,
+        measured_sparse_row_s=measured_sparse_row_s)
     ranked = sorted([("sparse", costs["sparse"]),
                      ("dense", costs["dense"])], key=lambda kv: kv[1])
     return ranked
