@@ -265,9 +265,14 @@ def main():
             # --prefix_share 32: the ISSUE-10 shared-system-prompt A/B
             # (paged+prefix vs PR-5 dense, interleaved windows) rides
             # it too, stamped as prefix_* fields alongside the paged
-            # pool occupancy (kv_*)
+            # pool occupancy (kv_*).
+            # --speculative 4: the ISSUE-13 speculative-decode A/B
+            # (γ=4 drafts verified per scoring dispatch; shared-prefix
+            # + natural-text regimes + the bs1 dispatch-floor probe on
+            # the dispatch-bound shape), stamped as spec_* fields +
+            # the accepted_tokens_per_dispatch figure perfgate gates
             _run(["--device", "CPU", "--fast", "--megastep", "8",
-                  "--prefix_share", "32"])
+                  "--prefix_share", "32", "--speculative", "4"])
             import serving_bench as smod
             return importlib.reload(smod).main()
         finally:
@@ -828,7 +833,9 @@ def main():
         # continuous-batching stamp (paddle_tpu.serving): engine vs
         # sequential tokens/s, speedup, occupancy, token identity,
         # request-level SLO percentiles (TTFT/TPOT p50/p95) + the
-        # fused-K megastep engine pass (megastep_* fields)
+        # fused-K megastep engine pass (megastep_* fields) + the
+        # ISSUE-13 speculative-decode A/B (spec_* fields incl. the
+        # perfgate-gated accepted_tokens_per_dispatch)
         out["serving"] = serving_summary
     if megastep_summary is not None:
         # megastep K-sweep stamp (ISSUE 7): K=1 vs K=8 interleaved

@@ -28,6 +28,7 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.serving.fleet",
     "paddle_tpu.serving.kvpool",
     "paddle_tpu.serving.sampling",
+    "paddle_tpu.serving.spec",
     "paddle_tpu.serving.sparse",
     "paddle_tpu.serving.sparse.cache",
     "paddle_tpu.serving.sparse.scoring",

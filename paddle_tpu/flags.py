@@ -35,10 +35,11 @@ Registered flags:
   serving*        —     paddle_tpu.serving continuous-batching engine
                         knobs (prefill chunk length, admission window,
                         fused decode megastep K, paged-KV layout /
-                        block size / pool size / prefix cache) and
-                        serving.fleet router knobs (per-replica
-                        in-flight window, global shed bound,
-                        stall-watchdog deadline)
+                        block size / pool size / prefix cache,
+                        speculative decode: on/off, draft length
+                        gamma, drafter tier) and serving.fleet router
+                        knobs (per-replica in-flight window, global
+                        shed bound, stall-watchdog deadline)
   megastep_inflight int Executor.run_steps async dispatch window depth
                         (2 = double buffering)
   telemetry*      —     monitor.collector scrape-only TelemetryServer
@@ -220,6 +221,40 @@ _register("serving_prefix_cache", bool, True,
           "prompt shares a cached full-block prefix skips those "
           "prefill chunks entirely (refcounted chains, LRU eviction "
           "under pool pressure). Requires serving_paged")
+_register("serving_speculative", bool, False,
+          "serving.Engine speculative decode (ISSUE 13): a cheap "
+          "drafter proposes up to serving_spec_gamma tokens per live "
+          "slot and ONE paged-attention scoring dispatch verifies all "
+          "of them — every dispatch emits 1..gamma+1 tokens, breaking "
+          "the bs1 per-dispatch floor. Temp-0 output stays bitwise "
+          "the non-speculative engine's (accept-longest-prefix "
+          "against the model's own tokens); requires serving_paged")
+_register("serving_spec_gamma", int, 4,
+          "speculative draft length gamma: tokens proposed per live "
+          "slot per iteration. A STATIC shape constant of the scoring "
+          "program (one compile per gamma; Engine.warmup pre-pays "
+          "it). 0 disables speculation outright — the engine runs "
+          "the existing programs cost-for-cost")
+_register("serving_spec_drafter", str, "ngram",
+          "speculative drafter tier: 'ngram' (host-side prompt/n-gram "
+          "lookup over the request's own token chain + the radix "
+          "prefix cache's published chains — zero device cost) or "
+          "'truncated' (a serving_spec_layers-deep pass over the same "
+          "weights, one extra fused dispatch per drafted iteration)")
+_register("serving_spec_ngram", int, 3,
+          "longest suffix n-gram the ngram drafter matches (falls "
+          "back to shorter suffixes down to serving_spec_ngram_min)")
+_register("serving_spec_ngram_min", int, 2,
+          "shortest suffix n-gram the ngram drafter accepts as "
+          "evidence. 2 (default) skips weak single-token matches — "
+          "measured: mostly-rejected drafts whose scoring dispatches "
+          "cost more than they return; 3 drafts only on the "
+          "strongest evidence (highest acceptance rate, fewest "
+          "drafted iterations)")
+_register("serving_spec_layers", int, 0,
+          "transformer layers the 'truncated' drafter runs (0 = "
+          "n_layer // 2). Draft quality only moves the acceptance "
+          "rate, never the output")
 _register("serving_fleet_window", int, 8,
           "serving.fleet Router per-replica in-flight window "
           "(backpressure): at most this many journaled requests are "

@@ -380,7 +380,8 @@ class TransformerLMInfer(TransformerInfer):
         return {"pool_k": jnp.zeros(shape, dtype),
                 "pool_v": jnp.zeros(shape, dtype)}
 
-    def _step_logits_paged(self, tok, state, pos, btab, write_mask=None):
+    def _step_logits_paged(self, tok, state, pos, btab, write_mask=None,
+                           n_layers=None):
         """Per-slot incremental step over the PAGED pool: like
         ``_step_logits_slots`` but each slot's K/V live in the shared
         block pool, addressed through its block table ``btab``
@@ -390,7 +391,13 @@ class TransformerLMInfer(TransformerInfer):
         runs over the exact dense-path axis length — greedy logits are
         bitwise the dense step's (token identity by construction, not
         by tolerance; pinned in tests/test_serving.py which runs the
-        whole suite over this path)."""
+        whole suite over this path).
+
+        ``n_layers`` (a trace-time constant) runs only the FIRST n
+        layers — the speculative tier-B drafter (ISSUE 13): a
+        truncated pass over the same weights and pool proposes tokens,
+        writing draft K/V only at layer rows the full-depth scoring
+        dispatch immediately overwrites."""
         nb, bs = state["pool_k"].shape[0], state["pool_k"].shape[3]
         s = tok.shape[0]
         dk = self.d_model // self.n_head
@@ -407,7 +414,9 @@ class TransformerLMInfer(TransformerInfer):
         wphys = phys if write_mask is None else \
             jnp.where(write_mask, phys, nb)
         pool_k, pool_v = state["pool_k"], state["pool_v"]
-        for i, p in enumerate(self.layers):
+        layers = self.layers if n_layers is None \
+            else self.layers[:n_layers]
+        for i, p in enumerate(layers):
             k_new, v_new = self._kv(p["attn"], x)        # [S, H, 1, dk]
             pool_k = pool_k.at[wphys, i, :, off, :].set(
                 k_new[:, :, 0, :], mode="drop")
@@ -427,6 +436,65 @@ class TransformerLMInfer(TransformerInfer):
             x = _ln(x + self._ffn(p, x), *p["ln2"])
         state["pool_k"], state["pool_v"] = pool_k, pool_v
         return x[:, 0, :] @ self.w_out, state
+
+    def _spec_logits_paged(self, toks, state, pos, btab, n_valid,
+                           write_mask=None):
+        """Speculative scoring (ISSUE 13): logits at ALL ``C = γ+1``
+        positions of every slot in ONE paged-attention dispatch.
+        ``toks`` [S, C] holds each slot's current token followed by its
+        γ drafted tokens; position j is written/read at cache position
+        ``pos[s] + j`` through the slot's block-table row, and the
+        logits at index j are the model's next-token distribution
+        AFTER consuming ``toks[s, :j+1]`` — exactly what the j-th
+        single step of ``_step_logits_paged`` would produce, which is
+        what the engine's accept-longest-prefix rule verifies against.
+
+        Ragged per-slot draft lengths ride the same masked-scatter
+        machinery as the chunk prefill: ``n_valid`` [S] is the number
+        of valid DRAFT tokens per slot, so positions ``j > n_valid[s]``
+        (and every position of a ``write_mask``-False slot) write at
+        index ``num_blocks`` and drop; their logits are garbage the
+        acceptance math never reads. The causal bias masks cache
+        positions beyond each query, so a rejected draft's stale K/V
+        from a PREVIOUS dispatch is never attended before the dispatch
+        that re-writes it."""
+        nb, bs = state["pool_k"].shape[0], state["pool_k"].shape[3]
+        s, c = toks.shape
+        dk = self.d_model // self.n_head
+        cpos = pos[:, None] + jnp.arange(c)[None, :]     # [S, C]
+        gather_pos = jnp.minimum(cpos, self.max_len - 1)
+        x = self.word_emb[toks] * (self.d_model ** 0.5) \
+            + self.pos_emb[gather_pos]                   # [S, C, D]
+        ar = jnp.arange(self.max_len)
+        # query j attends cache keys <= pos+j (its own K/V is written
+        # below before the attention reads the pool)
+        bias = jnp.where(ar[None, None, :] <= cpos[:, :, None], 0.0,
+                         -1e9)[:, None, :, :]            # [S, 1, C, L]
+        blk = jnp.minimum(cpos // bs, btab.shape[1] - 1)
+        off = cpos % bs
+        phys = jnp.take_along_axis(btab, blk, axis=1)    # [S, C]
+        valid = jnp.arange(c)[None, :] <= n_valid[:, None]
+        if write_mask is not None:
+            valid = valid & write_mask[:, None]
+        wphys = jnp.where(valid, phys, nb)               # OOB → dropped
+        pool_k, pool_v = state["pool_k"], state["pool_v"]
+        for i, p in enumerate(self.layers):
+            k_new, v_new = self._kv(p["attn"], x)        # [S, H, C, dk]
+            pool_k = pool_k.at[wphys, i, :, off, :].set(
+                k_new.transpose(0, 2, 1, 3), mode="drop")
+            pool_v = pool_v.at[wphys, i, :, off, :].set(
+                v_new.transpose(0, 2, 1, 3), mode="drop")
+            gk = pool_k[:, i][btab]          # [S, NB, H, bs, dk]
+            gv = pool_v[:, i][btab]
+            k = gk.transpose(0, 2, 1, 3, 4).reshape(
+                s, self.n_head, -1, dk)[:, :, :self.max_len]
+            v = gv.transpose(0, 2, 1, 3, 4).reshape(
+                s, self.n_head, -1, dk)[:, :, :self.max_len]
+            a = self._mha(p["attn"], x, k, v, bias)
+            x = _ln(x + a, *p["ln1"])
+            x = _ln(x + self._ffn(p, x), *p["ln2"])
+        state["pool_k"], state["pool_v"] = pool_k, pool_v
+        return x @ self.w_out, state                     # [S, C, V]
 
     def _prefill_chunk_paged(self, state, toks, start, n_valid,
                              btab_row):

@@ -36,6 +36,7 @@ __all__ = [
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
     "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
     "on_checkpoint", "on_serving_step", "on_serving_request",
+    "on_spec",
     "on_feed_plan", "on_megastep", "on_transform", "on_sparse_lookup",
     "on_sparse_evictions", "on_sparse_prefetch", "on_sparse_staleness",
     "summary", "session", "prometheus_text", "dump_metrics",
@@ -156,6 +157,20 @@ SERVING_PREEMPTIONS = _REG.counter(
     "ptpu_serving_preemptions_total",
     "requests preempted (blocks freed, re-queued for re-prefill) "
     "when the KV pool ran dry")
+# speculative decode tier (ISSUE 13): tokens drafted vs accepted and
+# the dispatches that verified them — acceptance rate is
+# accepted/drafted, accepted tokens per dispatch the bs1-floor lever
+SPEC_DRAFTED = _REG.counter(
+    "ptpu_spec_drafted_tokens_total",
+    "draft tokens proposed to speculative scoring dispatches")
+SPEC_ACCEPTED = _REG.counter(
+    "ptpu_spec_accepted_tokens_total",
+    "draft tokens accepted by the model's own (greedy/seeded-sampled) "
+    "tokens — each one is a decode step the dispatch floor never saw")
+SPEC_DISPATCHES = _REG.counter(
+    "ptpu_spec_dispatches_total",
+    "speculative scoring dispatches (each verifies gamma+1 positions "
+    "per live slot and emits 1..gamma+1 tokens per slot)")
 SERVING_STEP_SECONDS = _REG.histogram(
     "ptpu_serving_step_seconds",
     "wall time of one engine iteration (prefill chunk + decode step; "
@@ -770,7 +785,9 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
                     dispatched=None, kv_used=None, kv_total=None,
                     prefix_hits=None, prefix_misses=None, preempted=0,
                     cache_hits=None, cache_misses=None,
-                    cache_stale=None, cache_evictions=None):
+                    cache_stale=None, cache_evictions=None,
+                    spec_drafted=None, spec_accepted=None,
+                    spec_emitted=None, spec_dispatches=None):
     """One engine iteration completed: gauges reflect the step, counters
     accumulate, and (recorder armed) a ``serving_step`` row lands with
     the step wall time and the active trace id so the fleet timeline
@@ -834,6 +851,16 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
             extra["cache_misses"] = cache_misses
             extra["cache_stale"] = cache_stale
             extra["cache_evictions"] = cache_evictions
+        if spec_dispatches is not None:
+            # speculative engines (ISSUE 13): CUMULATIVE drafted/
+            # accepted/emitted token counts + scoring dispatches, same
+            # last-row-arithmetic discipline — acceptance rate and
+            # accepted-tokens-per-dispatch fall out of any window's
+            # last row
+            extra["spec_drafted"] = spec_drafted
+            extra["spec_accepted"] = spec_accepted
+            extra["spec_emitted"] = spec_emitted
+            extra["spec_dispatches"] = spec_dispatches
         rec.record("serving_step", engine=engine, active=active,
                    slots=slots, queue_depth=queue_depth,
                    emitted=emitted, admitted=admitted, retired=retired,
@@ -843,6 +870,19 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
 def on_prefix_lookup(hit):
     """One prefix-cache lookup at admission (paged engines)."""
     (PREFIX_HITS if hit else PREFIX_MISSES).inc()
+
+
+def on_spec(drafted=0, accepted=0):
+    """One speculative scoring dispatch completed (ISSUE 13):
+    ``drafted`` tokens were proposed across the live slots, ``accepted``
+    of them matched the model's own tokens and were committed (the
+    per-slot bonus token is counted by ptpu_serving_tokens_total like
+    every emitted token, not here)."""
+    SPEC_DISPATCHES.inc()
+    if drafted:
+        SPEC_DRAFTED.inc(drafted)
+    if accepted:
+        SPEC_ACCEPTED.inc(accepted)
 
 
 def on_prefix_evictions(n=1):

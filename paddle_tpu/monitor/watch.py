@@ -271,6 +271,19 @@ def fleet_lines(fleet_snap, now=None):
         lines.append(
             "  sparse   cache hits %d misses %d stale %d (hit rate "
             "%s)   prefetch rows %d" % (sp_h, sp_m, sp_s, rate, sp_r))
+    spd = _fleet_counter(fleet_snap, "ptpu_spec_drafted_tokens_total")
+    spa = _fleet_counter(fleet_snap,
+                         "ptpu_spec_accepted_tokens_total")
+    spn = _fleet_counter(fleet_snap, "ptpu_spec_dispatches_total")
+    if spn:
+        # speculative tier present (ISSUE 13): merged accept rate over
+        # every scraped engine — exact counter sums, like the sparse
+        # line above
+        spd, spa = spd or 0, spa or 0
+        rate = "n/a" if not spd else "%.0f%%" % (100.0 * spa / spd)
+        lines.append(
+            "  spec     drafted %d accepted %d (accept rate %s)   "
+            "dispatches %d" % (spd, spa, rate, spn))
     return lines
 
 
@@ -335,6 +348,25 @@ def render_frame(state, path, slo_verdict=None, now=None,
             "misses %d (hit rate %s)   preemptions %d"
             % (used, total, 100.0 * used / total if total else 0.0,
                h, m, rate, state.total_preemptions))
+    spec_last = {}
+    for s in state.serving_steps:
+        if s.get("spec_dispatches") is not None:
+            # speculative-decode counters are CUMULATIVE per engine
+            # row (ISSUE 13) — last row per engine, same discipline
+            # as the kv line above
+            spec_last[s.get("engine") or "engine"] = s
+    if spec_last:
+        rows = list(spec_last.values())
+        dr = sum(r.get("spec_drafted") or 0 for r in rows)
+        ac = sum(r.get("spec_accepted") or 0 for r in rows)
+        em = sum(r.get("spec_emitted") or 0 for r in rows)
+        di = sum(r.get("spec_dispatches") or 0 for r in rows)
+        rate = "n/a" if not dr else "%.0f%%" % (100.0 * ac / dr)
+        lines.append(
+            "spec      drafted %d accepted %d (accept rate %s)   "
+            "dispatches %d (%s tok/dispatch)"
+            % (dr, ac, rate, di,
+               "n/a" if not di else "%.2f" % (em / di)))
     sparse_last = {}
     for s in state.serving_steps:
         if s.get("cache_hits") is not None:

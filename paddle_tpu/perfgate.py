@@ -95,6 +95,24 @@ PROBES = (
           ("serving", "megastep_bs1_speedup"), "higher", 25.0),
     Probe("serving_prefix_speedup", ("serving", "prefix_speedup"),
           "higher", 25.0),
+    # speculative-decode probes (ISSUE 13): the verified-tokens-per-
+    # scoring-dispatch multiplication (the figure a chip converts to
+    # wall time at the dispatch floor), the acceptance rates of the
+    # two drafting regimes, and the bs1-floor wall A/B (on THIS CPU
+    # container the γ+1-position scoring compute is not free, so the
+    # wall ratio sits below 1 — the gate holds it from regressing and
+    # the chip round is where it flips; missing-on-baseline skips
+    # keep rounds r01-r06 comparable)
+    Probe("serving_spec_tok_per_dispatch",
+          ("serving", "accepted_tokens_per_dispatch"), "higher",
+          25.0),
+    Probe("serving_spec_bs1_speedup",
+          ("serving", "spec_bs1_speedup"), "higher", 25.0,
+          ("serving", "spec_bs1_spread_pct")),
+    Probe("serving_spec_shared_accept_rate",
+          ("serving", "spec_shared_accept_rate"), "higher", 30.0),
+    Probe("serving_spec_natural_accept_rate",
+          ("serving", "spec_natural_accept_rate"), "higher", 30.0),
     Probe("megastep_k1_tok_s", ("megastep", "k1_tok_s"), "higher",
           20.0, ("megastep", "k1_spread_pct")),
     Probe("megastep_k8_tok_s", ("megastep", "k8_tok_s"), "higher",

@@ -45,6 +45,18 @@ re-prefill, output unchanged) when the pool runs dry. Per-request
 ``SamplingParams`` (temperature / top-k / top-p / seed) execute inside
 the compiled step; temperature-0 stays bitwise-greedy.
 
+Speculative decode (ISSUE 13): with ``serving_speculative`` on, a
+cheap drafter (``spec.NgramDrafter`` prompt/n-gram lookup over the
+request's own chain + the prefix cache's published chains, or a
+flag-gated truncated-layer pass over the same weights) proposes up to
+``serving_spec_gamma`` tokens per live slot, and ONE multi-position
+paged-attention dispatch verifies them all — each dispatch emits
+1..γ+1 tokens whose values are exactly what sequential decode would
+have produced (accept-longest-prefix against the model's own greedy /
+counter-keyed-sampled tokens). Temperature-0 stays bitwise-identical
+to the non-speculative engine; drafting quality only moves the
+acceptance rate.
+
 Request-level observability (ISSUE 6): every ``Request`` handle
 carries its lifecycle attribution after retirement — ``queue_wait``,
 ``ttft``, ``tpot``, ``prefill_chunks``, ``latency()`` — mirrored into
@@ -62,8 +74,9 @@ from .fleet import (Overloaded, Replica, ReplicaClient,  # noqa: F401
 from .kvpool import (BlockPool, RadixCache,  # noqa: F401
                      bytes_per_block)
 from .sampling import SamplingParams  # noqa: F401
+from .spec import NgramDrafter  # noqa: F401
 
 __all__ = ["Engine", "Request", "sequential_generate", "Router",
            "Replica", "ReplicaServer", "ReplicaClient", "Supervisor",
            "Overloaded", "BlockPool", "RadixCache", "bytes_per_block",
-           "SamplingParams"]
+           "SamplingParams", "NgramDrafter"]

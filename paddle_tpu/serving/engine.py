@@ -45,6 +45,23 @@ Orca (iteration-level scheduling) and vLLM (slot/block-managed caches):
     (temperature / top-k / top-p / seed) execute in-step with per-slot
     PRNG state; temperature-0 requests stay BITWISE-greedy (the
     megastep/fleet token-identity contracts are untouched).
+  * **Speculative decode** (ISSUE 13; flags ``serving_speculative`` /
+    ``serving_spec_gamma`` / ``serving_spec_drafter``) — the lever
+    PR 10 deferred: a cheap drafter proposes up to γ tokens per live
+    slot (tier A: prompt/n-gram lookup over the request's own token
+    chain plus the radix cache's published chains, ``serving/spec.py``;
+    tier B: a truncated-layer pass over the same weights), the full
+    model scores all γ+1 positions in ONE paged-attention dispatch
+    (``_spec_logits_paged`` — multi-position masked writes, per-slot
+    ragged draft lengths through the block-table gather), and the
+    longest prefix of drafts matching the model's own tokens is
+    accepted IN-STEP — every dispatch lands 1..γ+1 VERIFIED tokens.
+    Correctness never depends on the drafter: temp-0 output stays
+    BITWISE the non-speculative engine's (accepted tokens ARE the
+    greedy tokens), seeded sampling replays identically (acceptance is
+    keyed on the same ``fold_in(seed, tokens_generated)`` draws), and
+    megastep / preemption / fleet exactly-once compose unchanged (a
+    no-draft iteration runs the existing programs cost-for-cost).
 
 Every engine iteration is instrumented: monitor gauges/counters
 (``ptpu_serving_*``), a ``serving_step`` flight-recorder row carrying
@@ -71,6 +88,7 @@ import jax.numpy as jnp
 from ..monitor import runtime as _monrt
 from ..trace import runtime as _trc
 from . import kvpool as _kvpool
+from . import spec as _spec
 from .sampling import SamplingParams, sample as _sample, \
     step_keys as _step_keys
 
@@ -229,12 +247,26 @@ class Engine:
     ``slots * ceil(max_len / block_size)`` — dense-capacity parity,
     with the savings coming from short requests and shared prefixes.
     Greedy output is token-identical across both layouts; per-request
-    ``sampling`` (``SamplingParams``) rides either."""
+    ``sampling`` (``SamplingParams``) rides either.
+
+    Speculative decode (ISSUE 13; flags ``serving_speculative`` /
+    ``serving_spec_gamma`` / ``serving_spec_drafter`` /
+    ``serving_spec_ngram`` / ``serving_spec_layers``):
+    ``speculative=True`` drafts up to ``spec_gamma`` tokens per live
+    slot each iteration and verifies all of them in one scoring
+    dispatch — requires the paged layout (the ragged per-slot draft
+    lengths ride the block-table gather). ``spec_drafter``: ``ngram``
+    (default; host-side prompt/n-gram lookup, ``serving/spec.py``) or
+    ``truncated`` (a ``spec_layers``-deep pass over the same weights,
+    one extra fused dispatch per drafted iteration). ``spec_gamma=0``
+    disables speculation outright — the engine is program-for-program
+    the non-speculative one."""
 
     def __init__(self, model, slots=8, prefill_chunk=None,
                  admission_wait=None, name="engine", megastep=None,
                  paged=None, block_size=None, num_blocks=None,
-                 prefix_cache=None):
+                 prefix_cache=None, speculative=None, spec_gamma=None,
+                 spec_drafter=None, spec_layers=None):
         if slots < 1:
             raise ValueError("slots must be >= 1, got %r" % (slots,))
         self.model = model
@@ -292,6 +324,48 @@ class Engine:
         else:
             self._pool = None
             self._prefix = None
+        # speculative decode (ISSUE 13): γ drafted tokens per live slot
+        # verified in ONE scoring dispatch. γ is a STATIC shape
+        # constant of the scoring program ([S, γ+1] feed), so one γ =
+        # one compile (warmup() pays it up front); γ=0 or
+        # speculative=False leaves every existing program untouched.
+        self._spec_gamma = max(0, int(
+            spec_gamma if spec_gamma is not None
+            else _flag("serving_spec_gamma", 4)))
+        spec_on = bool(speculative if speculative is not None
+                       else _flag("serving_speculative", False))
+        self._speculative = spec_on and self._spec_gamma > 0
+        self._spec_fn = None
+        self._draft_fn = None
+        self._drafter = None
+        self._spec_kind = None
+        if self._speculative:
+            if not self._paged:
+                raise ValueError(
+                    "speculative decode requires the paged KV layout "
+                    "(per-slot ragged draft lengths ride the "
+                    "block-table gather); pass paged=True or drop "
+                    "speculative")
+            kind = str(spec_drafter if spec_drafter is not None
+                       else _flag("serving_spec_drafter", "ngram"))
+            if kind not in ("ngram", "truncated"):
+                raise ValueError(
+                    "serving_spec_drafter must be 'ngram' or "
+                    "'truncated', got %r" % (kind,))
+            self._spec_kind = kind
+            self._drafter = _spec.NgramDrafter(
+                max_n=_flag("serving_spec_ngram", 3),
+                min_n=_flag("serving_spec_ngram_min", 2))
+            if kind == "truncated":
+                nl = int(spec_layers if spec_layers is not None
+                         else _flag("serving_spec_layers", 0))
+                if nl <= 0:
+                    nl = max(1, model.n_layer // 2)
+                self._spec_layers = min(nl, model.n_layer)
+                self._draft_fn = jax.jit(self._draft_truncated_impl,
+                                         donate_argnums=0)
+            self._spec_fn = jax.jit(self._spec_step_impl,
+                                    donate_argnums=0, static_argnums=3)
         self._admit_seq = itertools.count()  # admission priority order
         self._preempted_iter = 0
         self._cv = threading.Condition()
@@ -316,7 +390,9 @@ class Engine:
                       "megastep_dispatches": 0, "prefix_hits": 0,
                       "prefix_misses": 0, "prefix_hit_tokens": 0,
                       "prefix_evictions": 0, "preemptions": 0,
-                      "cow_copies": 0, "kv_peak_blocks": 0}
+                      "cow_copies": 0, "kv_peak_blocks": 0,
+                      "spec_dispatches": 0, "spec_drafted": 0,
+                      "spec_accepted": 0, "spec_emitted": 0}
         # optional completion hook (serving.fleet's ReplicaServer):
         # called with each Request AFTER its future resolves — retired
         # or failed — so an RPC front can deliver results event-driven
@@ -341,7 +417,11 @@ class Engine:
         lazily on its first mid-flight admission, stalling that
         iteration by a full XLA compile — and a PAGED K>1 engine
         previously compiled both paged paths mid-traffic (the
-        PR-7-measured 660 ms stall). ``sampled=True`` additionally
+        PR-7-measured 660 ms stall). A SPECULATIVE engine additionally
+        pre-compiles the γ-position scoring program (and the
+        truncated-layer draft program with the tier-B drafter): γ is a
+        static shape constant, so the first drafted batch would
+        otherwise eat that compile mid-traffic. ``sampled=True`` additionally
         pre-compiles the sampling-tail variants — pass it when the
         workload will carry ``SamplingParams``, otherwise the first
         stochastic request eats those compiles mid-traffic (the
@@ -370,6 +450,18 @@ class Engine:
                             self._megastep_impl, donate_argnums=0,
                             static_argnums=2)
                     state, _, _ = self._megastep_fn(state, btab, v)
+                if self._speculative:
+                    # the speculative scoring program too (ISSUE 13
+                    # satellite): γ is a static shape constant, so
+                    # without this the first DRAFTED batch eats the
+                    # scoring compile mid-traffic — the exact stall
+                    # PR 7/10 killed twice for the step/megastep paths
+                    zdn = jnp.zeros(
+                        (self.slots, self._spec_gamma + 1), jnp.int32)
+                    state, _ = self._spec_fn(state, btab, zdn, v)
+            if self._speculative and self._draft_fn is not None:
+                state, _ = self._draft_fn(
+                    state, btab, jnp.zeros((self.slots,), jnp.int32))
             self._state = state
         return self
 
@@ -543,6 +635,123 @@ class Engine:
             body, dict(state), None, length=self._megastep)
         return state, emits, fins
 
+    def _spec_step_impl(self, state, btab, dn, sampled=False):
+        """Speculative scoring + in-step acceptance (ISSUE 13): ONE
+        paged-attention dispatch scores every slot's current token plus
+        its drafted tokens, then accepts the longest prefix of drafts
+        matching the model's OWN next tokens — greedy argmax for
+        temperature-0 slots, the counter-keyed draw
+        (``fold_in(seed, tokens_generated + j)``) for sampled slots,
+        position-indexed exactly as j successive single steps would
+        have drawn. Emitting only those tokens is what makes
+        speculative output bitwise the non-speculative engine's: a
+        WRONG draft costs a rejection, never a wrong token.
+
+        ``dn`` [S, γ+1] int32 packs the per-slot draft length (column
+        0, ragged 0..γ) with the γ draft tokens — ONE host→device
+        transfer per dispatch; the reply packs emits/n_emit/fin into
+        one int32 fetch the same way (the per-dispatch host tax is on
+        the bs1 floor this feature exists to break).
+
+        Returns ``(state, out [S, γ+3])``: columns 0..γ are the
+        emitted tokens (end_id filler past each slot's count), column
+        γ+1 the per-slot emit count (1..γ+1 for active slots — the
+        bonus token the scoring logits buy rides every dispatch,
+        truncated at EOS inside an accepted draft and at the slot's
+        ``max_new`` budget), column γ+2 the retirement flag. Cache
+        position / count / score / PRNG counter advance by the emit
+        count, so the next dispatch (speculative or not) continues
+        exactly where K single steps would have."""
+        state = dict(state)
+        tok, pos, active = state["tok"], state["pos"], state["active"]
+        count = state["count"]
+        drafts = dn[:, 1:]
+        c = drafts.shape[1] + 1
+        toks = jnp.concatenate([tok[:, None], drafts], axis=1)
+        nd = jnp.where(active, dn[:, 0], 0)
+        logits, state = self.model._spec_logits_paged(
+            toks, state, pos, btab, nd, write_mask=active)
+        logits32 = logits.astype(jnp.float32)        # [S, C, V]
+        logp = jax.nn.log_softmax(logits32)
+        greedy = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        if sampled:
+            s = tok.shape[0]
+            counts = count[:, None] + jnp.arange(c)[None, :]
+            keys = _step_keys(jnp.repeat(state["seed"], c),
+                              counts.reshape(-1))
+            rep = lambda a: jnp.repeat(a, c)
+            drawn = _sample(logits32.reshape(s * c, -1),
+                            rep(state["temp"]), rep(state["topk"]),
+                            rep(state["topp"]), keys).reshape(s, c)
+            target = jnp.where((state["temp"] > 0.0)[:, None], drawn,
+                               greedy)
+        else:
+            target = greedy
+        # accept-longest-prefix: draft j+1 must equal the model's own
+        # token at position j (cumprod stops at the first mismatch)
+        match = (toks[:, 1:] == target[:, :-1]) \
+            & (jnp.arange(c - 1)[None, :] < nd[:, None])
+        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                    axis=1)                          # accepted drafts
+        ncap = jnp.minimum(m + 1, state["max_new"] - count)
+        jj = jnp.arange(c)[None, :]
+        is_end = (target == jnp.int32(self.model.end_id)) \
+            & (jj < ncap[:, None])
+        end_pos = jnp.min(jnp.where(is_end, jj, c), axis=1)
+        n_emit = jnp.where(active, jnp.minimum(ncap, end_pos + 1), 0)
+        fin = active & ((end_pos < ncap)
+                        | (count + n_emit >= state["max_new"]))
+        emit_mask = jj < n_emit[:, None]
+        tok_logp = jnp.take_along_axis(
+            logp, target[:, :, None], axis=-1)[:, :, 0]
+        state["score"] = state["score"] + jnp.sum(
+            jnp.where(emit_mask, tok_logp, 0.0), axis=1)
+        last = jnp.maximum(n_emit - 1, 0)
+        new_tok = jnp.take_along_axis(target, last[:, None],
+                                      axis=1)[:, 0]
+        state["tok"] = jnp.where(active, new_tok, tok)
+        state["pos"] = pos + n_emit
+        state["count"] = count + n_emit
+        state["active"] = active & ~fin
+        emits = jnp.where(emit_mask, target,
+                          jnp.int32(self.model.end_id))
+        out = jnp.concatenate(
+            [emits, n_emit[:, None], fin.astype(jnp.int32)[:, None]],
+            axis=1)
+        return state, out
+
+    def _draft_truncated_impl(self, state, btab, n_draft):
+        """Tier-B drafter: γ greedy decode steps through only the
+        FIRST ``spec_layers`` transformer layers (same weights, same
+        paged pool), scanned into ONE dispatch. Draft K/V lands only
+        at the truncated layers of positions the scoring dispatch
+        immediately re-writes at FULL depth, so the drafter needs no
+        KV state of its own; writes beyond a slot's ``n_draft`` budget
+        are masked (they would fall past its block table). Returns
+        ``(state, drafts [S, γ])``. Draft quality only moves the
+        acceptance rate — never the output."""
+        state = dict(state)
+        active = state["active"]
+        pool = {"pool_k": state["pool_k"], "pool_v": state["pool_v"]}
+
+        def body(carry, _):
+            pool, tok, pos, j = carry
+            wmask = active & (j <= n_draft)
+            logits, pool = self.model._step_logits_paged(
+                tok, pool, pos, btab, write_mask=wmask,
+                n_layers=self._spec_layers)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (pool, nxt, pos + 1, j + 1), nxt
+
+        (pool, _, _, _), drafts = jax.lax.scan(
+            body,
+            (pool, state["tok"], state["pos"],
+             jnp.zeros((), jnp.int32)),
+            None, length=self._spec_gamma)
+        state["pool_k"], state["pool_v"] = pool["pool_k"], \
+            pool["pool_v"]
+        return state, jnp.transpose(drafts)          # [γ,S] → [S,γ]
+
     def _prefill_impl(self, state, slot, toks, start, n_valid,
                       btab_row):
         if self._paged:
@@ -622,13 +831,17 @@ class Engine:
             rec["refs"].append(b)
         return True
 
-    def _alloc_one(self, rec):
+    def _alloc_one(self, rec, preempt=True):
         """One block for ``rec``, or None when ``rec`` was preempted to
         make room (self-preemption: the pool cannot serve it without
         taking blocks from strictly HIGHER-priority — earlier-admitted
         — requests, so ``rec`` yields instead; with admission
         priorities preserved across preemption this cannot ping-pong,
-        the oldest request always keeps its blocks and finishes)."""
+        the oldest request always keeps its blocks and finishes).
+        ``preempt=False`` stops the pressure ladder after the
+        prefix-eviction rung and returns None with ``rec`` untouched —
+        the speculative soft-growth contract (OPTIONAL draft positions
+        must never evict committed work)."""
         while True:
             got = self._pool.alloc(1)
             if got is not None:
@@ -639,6 +852,8 @@ class Engine:
                     self.stats["prefix_evictions"] += freed
                     _monrt.on_prefix_evictions(freed)
                     continue
+            if not preempt:
+                return None
             victim = self._pick_victim()
             if victim is None or victim["seq"] <= rec["seq"]:
                 # nobody holds blocks, or every holder outranks rec
@@ -711,6 +926,41 @@ class Engine:
         rec["shared"] = bi             # shared copy; cache keeps its own
         self.stats["cow_copies"] += 1
         return True
+
+    def _grow_blocks_soft(self, rec, last_pos):
+        """Best-effort table growth for SPECULATIVE write positions:
+        the shared allocation ladder minus its preemption rung
+        (``_alloc_one(preempt=False)``) — drafts are optional work,
+        and taking committed blocks for a guess would churn real
+        progress (worst case, a request self-preempting for its own
+        drafts forever). Returns the highest position the table now
+        covers; the caller shrinks the draft to fit."""
+        last_pos = min(int(last_pos), self.model.max_len - 1)
+        need = last_pos // self._block_size + 1 - len(rec["table"])
+        for _ in range(max(0, need)):
+            b = self._alloc_one(rec, preempt=False)
+            if b is None:
+                break
+            rec["table"].append(b)
+            rec["refs"].append(b)
+        return len(rec["table"]) * self._block_size - 1
+
+    def _publish_prefix(self, rec, req):
+        """Publish a slot's full prompt blocks to the prefix cache
+        after its first decode emit (position P-1 is then complete, so
+        every full prompt block is). Refcounted — the request keeps
+        its own refs. Keyed on the RECORD (fresh each admission), not
+        t_first_token: a request preempted after its first token but
+        before publishing must still publish on resume;
+        re-publishing an already-cached chain dedups to a no-op."""
+        if not self._paged or self._prefix is None or rec["inserted"]:
+            return
+        rec["inserted"] = True
+        bs = self._block_size
+        nfull = len(req.prompt) // bs
+        if nfull:
+            self._prefix.insert(req.prompt[:nfull * bs],
+                                rec["table"][:nfull])
 
     def _release_blocks(self, rec):
         """Drop every pool ref the record holds (own allocations AND
@@ -804,6 +1054,16 @@ class Engine:
                           "prefix_hits": self.stats["prefix_hits"],
                           "prefix_misses": self.stats["prefix_misses"],
                           "preempted": self._preempted_iter}
+                    if self._speculative:
+                        # CUMULATIVE like the prefix counters: a
+                        # window's acceptance rate is last-row
+                        # arithmetic, never a sum
+                        kv["spec_drafted"] = self.stats["spec_drafted"]
+                        kv["spec_accepted"] = \
+                            self.stats["spec_accepted"]
+                        kv["spec_emitted"] = self.stats["spec_emitted"]
+                        kv["spec_dispatches"] = \
+                            self.stats["spec_dispatches"]
                 _monrt.on_serving_step(
                     active=active, slots=self.slots, queue_depth=depth,
                     emitted=emitted, admitted=admitted,
@@ -989,6 +1249,160 @@ class Engine:
                     np.float32(sp.top_p), np.uint32(sp.seed))
                 rec["live"] = True
 
+    def _spec_cap(self, rec):
+        """How many draft tokens this live slot can USE: bounded by γ,
+        by its remaining ``max_new`` budget (n accepted drafts emit
+        n+1 tokens), and by ``max_len`` (the scoring dispatch writes
+        positions ``next_pos .. next_pos+n``)."""
+        req = rec["req"]
+        return min(self._spec_gamma,
+                   req.max_new - len(req.tokens) - 1,
+                   self.model.max_len - 1 - rec["next_pos"])
+
+    def _build_drafts(self):
+        """The drafting tier of one speculative iteration: propose up
+        to γ tokens per live slot (tier A: host n-gram lookup over the
+        request's own chain + the radix cache's published chains;
+        tier B: one truncated-layer dispatch), then grow block tables
+        to cover every drafted write position (the pressure ladder may
+        preempt here — a vanished record's drafts are zeroed). Returns
+        ``(drafts [S, γ] int32, n_draft [S] int32)``, or ``(None,
+        None)`` when NO slot drafted — the caller then runs the
+        existing plain/megastep programs, so a draftless iteration
+        costs exactly what a non-speculative engine pays."""
+        g = self._spec_gamma
+        nd = np.zeros((self.slots,), np.int32)
+        drafts = np.zeros((self.slots, g), np.int32)
+        if self._spec_kind == "truncated":
+            for slot in range(self.slots):
+                rec = self._recs[slot]
+                if rec is not None and rec["live"]:
+                    nd[slot] = max(0, self._spec_cap(rec))
+        else:
+            chains = None
+            for slot in range(self.slots):
+                rec = self._recs[slot]
+                if rec is None or not rec["live"]:
+                    continue
+                req = rec["req"]
+                cap = self._spec_cap(rec)
+                if cap <= 0:
+                    continue
+                if chains is None:       # one trie walk per iteration
+                    chains = (self._prefix.token_chains()
+                              if self._prefix is not None else ())
+                prop = self._drafter.propose(req.prompt + req.tokens,
+                                             cap, extra_chains=chains)
+                if prop:
+                    drafts[slot, :len(prop)] = prop
+                    nd[slot] = len(prop)
+        if not nd.any():
+            return None, None
+        # block coverage for the WHOLE dispatch, in two tiers. EVERY
+        # live slot writes its next position even with zero drafts (it
+        # rides the scoring dispatch as a plain step), so the
+        # mandatory single-step coverage walks the full pressure
+        # ladder exactly like the plain path — skipping a draftless
+        # slot here would let its boundary-crossing write land in an
+        # uncovered table entry (block 0: ANOTHER request's cache).
+        # Draft positions are OPTIONAL work and only grow best-effort
+        # (never preempting): evicting committed progress — worst
+        # case, self-preempting in a loop — to make room for a guess
+        # would turn speculation into churn. Re-read each record per
+        # slot: an earlier slot's mandatory growth may have preempted
+        # this one.
+        for slot in range(self.slots):
+            rec = self._recs[slot]
+            if rec is None or not rec["live"]:
+                nd[slot] = 0
+                continue
+            if not self._ensure_blocks(rec, rec["next_pos"]):
+                nd[slot] = 0           # rec yielded its own slot
+                continue
+            if nd[slot]:
+                covered = self._grow_blocks_soft(
+                    rec, rec["next_pos"] + int(nd[slot]))
+                nd[slot] = max(0, min(int(nd[slot]),
+                                      covered - rec["next_pos"]))
+        for slot in range(self.slots):  # a LATER slot's mandatory
+            rec = self._recs[slot]      # growth may have preempted an
+            if rec is None or not rec["live"]:  # earlier drafted one
+                nd[slot] = 0
+        if not nd.any():
+            return None, None
+        if self._spec_kind == "truncated":
+            self._state, dr = self._draft_fn(
+                self._state, self._btab_all(), jnp.asarray(nd))
+            drafts = np.asarray(dr)
+        return drafts, nd
+
+    def _decode_spec(self, drafts, nd):
+        """One speculative scoring dispatch over the active batch:
+        γ+1 positions per slot verified at once, the accepted prefix
+        (plus the bonus token) committed host-side. Counts as ONE
+        decode step for occupancy/latency purposes — the whole point
+        is that it emits MORE THAN ONE token."""
+        live = [s for s, r in enumerate(self._recs)
+                if r is not None and r["live"]]
+        if not live:
+            return 0, [], 0, 0, 0
+        btab = self._btab_all()
+        sampled = any(
+            self._recs[s]["req"].sampling is not None for s in live)
+        # ONE packed upload (draft lengths + tokens) and ONE packed
+        # fetch (emits + counts + fins): per-dispatch host transfers
+        # are exactly the tax this path exists to amortize
+        dn = np.concatenate([nd[:, None], drafts], axis=1)
+        self._state, out = self._spec_fn(self._state, btab,
+                                         jnp.asarray(dn), sampled)
+        out = np.asarray(out)
+        g1 = self._spec_gamma + 1
+        emits, n_emit, fins = out[:, :g1], out[:, g1], out[:, g1 + 1]
+        drafted = int(nd.sum())
+        accepted = 0
+        emitted = 0
+        scores = None
+        finished = []
+        self.stats["spec_dispatches"] += 1
+        self.stats["spec_drafted"] += drafted
+        self.stats["decode_steps"] += 1
+        self.stats["active_slot_steps"] += len(live)
+        now = time.perf_counter()
+        for slot in live:
+            rec = self._recs[slot]
+            req = rec["req"]
+            ne = int(n_emit[slot])
+            for t in emits[slot, :ne]:
+                req.tokens.append(int(t))
+            emitted += ne
+            accepted += max(0, ne - 1)
+            rec["next_pos"] += ne
+            self._publish_prefix(rec, req)
+            if ne and req.t_first_token is None:
+                req.t_first_token = now
+                try:
+                    # guarded like _decode's: an escaping span-log
+                    # write must not strand earlier-popped slots
+                    with _trc.child_span(
+                            "request.first_token", req._span,
+                            step_span=self._step_span_id()):
+                        pass
+                    req._span.annotate(ttft=req.ttft)
+                except Exception:
+                    pass
+            if fins[slot]:
+                req.t_retire = now
+                if scores is None:  # one [S] fetch per dispatch
+                    scores = np.asarray(self._state["score"])
+                finished.append((req, float(scores[slot])))
+                self._release_blocks(rec)
+                self._recs[slot] = None
+        self.stats["spec_accepted"] += accepted
+        self.stats["spec_emitted"] += emitted
+        self.stats["tokens"] += emitted
+        _monrt.on_spec(drafted=drafted, accepted=accepted)
+        return len(live), finished, 1, emitted, 1
+
     def _decode(self, k=1):
         """One decode dispatch over the active batch: a single step
         (k=1, the PR-5 path), or a fused K-step megastep — ONE device
@@ -996,7 +1410,19 @@ class Engine:
         grows every live slot's block table to cover its next k write
         positions (one table serves the whole fused dispatch; the
         pressure ladder may preempt here). Returns (slots active at
-        dispatch, finished, steps run, tokens emitted)."""
+        dispatch, finished, steps run, tokens emitted).
+
+        A speculative engine first drafts (ISSUE 13): when any live
+        slot has draft tokens this iteration, ONE scoring dispatch
+        verifies them all and the plain/megastep paths don't run; a
+        draftless iteration falls through to the EXISTING programs
+        cost-for-cost (the all-greedy/no-draft contract megastep K
+        composition rides — a fused dispatch still serves iterations
+        the drafter has nothing for)."""
+        if self._speculative:
+            drafts, nd = self._build_drafts()
+            if drafts is not None:
+                return self._decode_spec(drafts, nd)
         if self._paged:
             for slot in range(self.slots):
                 # re-read per iteration: an earlier slot's allocation
@@ -1064,24 +1490,7 @@ class Engine:
                 emitted += 1
                 if self._paged:
                     rec["next_pos"] += 1   # mirrors the device pos
-                if self._paged and self._prefix is not None \
-                        and not rec["inserted"]:
-                    # the slot's first decode emit wrote position P-1,
-                    # so every full prompt block is complete — publish
-                    # the chain (refcounted; the request keeps its own
-                    # refs) so later admissions sharing the prefix
-                    # skip its prefill outright. Keyed on the RECORD
-                    # (fresh each admission), not t_first_token: a
-                    # request preempted after its first token but
-                    # before publishing must still publish on resume;
-                    # re-publishing an already-cached chain dedups to
-                    # a no-op
-                    rec["inserted"] = True
-                    bs = self._block_size
-                    nfull = len(req.prompt) // bs
-                    if nfull:
-                        self._prefix.insert(req.prompt[:nfull * bs],
-                                            rec["table"][:nfull])
+                self._publish_prefix(rec, req)
                 if req.t_first_token is None:
                     req.t_first_token = now
                     try:

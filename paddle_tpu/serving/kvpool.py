@@ -195,6 +195,28 @@ class RadixCache:
             self.misses += 1
         return blocks, len(blocks) * bs
 
+    def token_chains(self, limit=64):
+        """The published prompt chains as plain token tuples (root-to-
+        leaf trie paths), most recently used first, at most ``limit``.
+
+        This is the TEXT surface of the cache (ISSUE 13): the
+        speculative drafter's prompt-lookup tier reads the token
+        sequences other requests published and proposes continuations
+        from them. Reading text takes NO pool refs — drafting can
+        never pin a block the pressure ladder wants back, and a wrong
+        chain costs nothing but a rejected draft."""
+        out, stack = [], [(self._root, ())]
+        while stack:
+            node, toks = stack.pop()
+            for child in node.children.values():
+                ct = toks + child.key
+                if child.children:
+                    stack.append((child, ct))
+                else:
+                    out.append((child.last_use, ct))
+        out.sort(key=lambda p: -p[0])
+        return [toks for _, toks in out[:int(limit)]]
+
     def insert(self, tokens, blocks):
         """Publish a request's full-block prompt chain. ``tokens`` must
         be ``len(blocks) * block_size`` ids; ``blocks[i]`` holds the
