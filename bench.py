@@ -782,6 +782,67 @@ def main():
     transform_summary = guarded("transform-probe", transform_probe,
                                 errors)
 
+    def alerts_probe():
+        """ISSUE-14 signal-plane probe: an ARMED mini-fleet (private
+        registry behind a real TelemetryServer, scraped by a real
+        Collector over RPC) driven on a synthetic clock — a clean
+        interleaved window first (healthy traffic + benign queue
+        wiggle; any transition is a FALSE POSITIVE), then an injected
+        error burst + queue pressure, stamping detection latency in
+        scrape rounds from the injected fault to the page-severity
+        FIRING. Synthetic-clock rounds make the window math exact and
+        the probe sub-second — no sleeping on scrape intervals."""
+        from paddle_tpu.monitor import metrics as mm
+        from paddle_tpu.monitor import signals as sg
+        from paddle_tpu.monitor.collector import (Collector,
+                                                  TelemetryServer)
+        reg = mm.Registry()
+        ret = reg.counter("ptpu_serving_retirements_total", "")
+        fail = reg.counter("ptpu_serving_request_failures_total", "")
+        qd = reg.gauge("ptpu_serving_queue_depth", "")
+        srv = TelemetryServer(registry=reg, role="replica").start()
+        col = Collector(static=[("replica", srv.endpoint)])
+        try:
+            sig = sg.Signals(spec={"objectives": [
+                {"metric": "error_rate", "target": 0.95,
+                 "windows": [{"short_s": 4.0, "long_s": 16.0,
+                              "burn_rate": 2.0,
+                              "severity": "page"}]}]})
+            t0 = 1_000_000.0
+            clean_rounds, false_pos = 12, 0
+            for r in range(clean_rounds):
+                ret.inc(20)
+                qd.set(r % 3)
+                col.scrape_once()
+                false_pos += len(sig.observe(
+                    snapshot=col.fleet_snapshot(), now=t0 + r))
+            detect = None
+            for r in range(clean_rounds, clean_rounds + 12):
+                fail.inc(20)             # full outage: every request
+                qd.set(64)               # fails + the queue backs up
+                col.scrape_once()
+                trs = sig.observe(snapshot=col.fleet_snapshot(),
+                                  now=t0 + r)
+                if any(t["state"] == "FIRING"
+                       and t["severity"] == "page" for t in trs):
+                    detect = r - clean_rounds + 1
+                    break
+            hint = sig.scale_hint()
+            probe = {
+                "clean_rounds": clean_rounds,
+                "false_positives": false_pos,
+                "detection_rounds": detect,
+                "scale_hint": hint.direction,
+                "scale_magnitude": hint.magnitude,
+            }
+            print("alerts probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            col.close()
+            srv.stop()
+
+    alerts_summary = guarded("alerts-probe", alerts_probe, errors)
+
     ips, res_spread, res_samples = agg(res_s)
     large_flops_tok = flops_per_token(L=8, D=1024, FFN=4096, T=1024,
                                       V=8192)
@@ -853,6 +914,13 @@ def main():
         # latency) + the armed kill pass's resubmission/exactly-once
         # verdict
         out["fleet"] = fleet_summary
+    if alerts_summary is not None:
+        # signal-plane stamp (ISSUE 14): armed mini-fleet alerting
+        # probe — detection latency in scrape rounds from injected
+        # fault to page-severity FIRING, zero-false-positive verdict
+        # over the clean interleaved window, and the scale hint the
+        # direction-2 supervisor would have consumed
+        out["alerts"] = alerts_summary
     if recsys_summary is not None:
         # sparse-serving stamp (ISSUE 12): cold-vs-warm hot-ID cache
         # scoring throughput A/B, final cache hit rate, measured

@@ -48,6 +48,9 @@ Registered flags:
   slo_spec        str   default SLO spec JSON for python -m
                         paddle_tpu.slo and the live verdict line of
                         python -m paddle_tpu.monitor watch
+  signals_spec    str   default spec for python -m paddle_tpu.monitor
+                        alerts (burn-rate objectives + sustained-rule
+                        overrides; falls back to slo_spec)
 
 Distributed bootstrap envs (read by distributed.launch, not here):
   PADDLE_COORDINATOR, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID.
@@ -309,6 +312,12 @@ _register("telemetry_slots", int, 16,
           "how many 'telemetry' role slots the lease registry offers "
           "(register_endpoint desired count for flag-armed "
           "TelemetryServers)")
+_register("signals_spec", str, "",
+          "default SLO/signals spec JSON for python -m "
+          "paddle_tpu.monitor alerts: error-budget objectives arm "
+          "burn-rate rules, the spec's 'rules' object overrides the "
+          "sustained-condition defaults (monitor/signals.py). Empty "
+          "= fall back to slo_spec, then defaults-only rules")
 _register("slo_spec", str, "",
           "default SLO spec JSON path: python -m paddle_tpu.slo uses "
           "it when no spec argument is given, and python -m "

@@ -13,6 +13,10 @@ The pieces (see each module's docstring):
             exact-sum merge, one fleet-labeled re-export (imported
             lazily — it needs the distributed tier)
   goodput   goodput/badput wall-time attribution over recorder rows
+  signals   SLO burn-rate alerting + sustained-condition rules with
+            hysteresis + the autoscaling scale_hint() plane (python
+            -m paddle_tpu.monitor alerts; imported lazily by the
+            watch dashboards)
 
 Quickstart::
 
@@ -40,6 +44,7 @@ from .runtime import (  # noqa: F401
     set_tokens_per_step, on_compile, on_cache_hit, on_step, on_nan_trip,
     on_retry, on_reconnect, on_fault, on_rollback, on_resume,
     on_checkpoint, on_serving_step, on_serving_request, on_feed_plan,
+    on_alert,
     on_megastep, on_transform, feed_nbytes,
     tokens_in_feeds, sync_every, step_timer, summary, session,
     prometheus_text, dump_metrics, maybe_enable_from_flags,

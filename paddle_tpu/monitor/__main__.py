@@ -9,6 +9,14 @@
         # LIVE fleet scrape over RPC (monitor/collector.py) — no files
     python -m paddle_tpu.monitor goodput run.jsonl [rep1.jsonl ...]
         # goodput/badput wall-time attribution (monitor/goodput.py)
+    python -m paddle_tpu.monitor alerts --fleet <kv-endpoint>
+        # LIVE streaming rule engine (monitor/signals.py): SLO burn-
+        # rate + sustained-condition alerts over the scraped fleet
+    python -m paddle_tpu.monitor alerts run.jsonl [--spec slo.json]
+        # offline replay: the same rules over a recorded log
+    python -m paddle_tpu.monitor alerts --incident run.jsonl ...
+        # timeline splicing alert rows with the goodput ledger's
+        # badput intervals ("what happened at 14:32")
 
 The summary covers BOTH workloads a log may carry: training `step`
 rows (step count, latency percentiles, compile/recompile causes, MFU,
@@ -251,6 +259,152 @@ def _watch_main(argv):
     return 1 if args.once and frame is None else 0
 
 
+def _alerts_main(argv):
+    from . import signals as sg
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.monitor alerts",
+        description="SLO burn-rate + sustained-condition alerting "
+                    "(monitor/signals.py): stream against a live "
+                    "scraped fleet (--fleet/--endpoint), replay a "
+                    "recorded log, or render an --incident timeline")
+    p.add_argument("log", nargs="*",
+                   help="flight-recorder .jsonl path(s) to replay "
+                        "offline (or to splice with --incident)")
+    p.add_argument("--fleet", default=None, metavar="KV_ENDPOINT",
+                   help="live mode: discover processes from this "
+                        "membership KV registry and scrape them over "
+                        "RPC each --interval")
+    p.add_argument("--endpoint", action="append", default=[],
+                   metavar="ROLE=HOST:PORT",
+                   help="extra static scrape endpoint for live mode "
+                        "(repeatable)")
+    p.add_argument("--spec", default=None,
+                   help="SLO/signals spec JSON: error-budget "
+                        "objectives arm burn rules, its 'rules' "
+                        "object overrides the sustained-condition "
+                        "defaults (default: the PADDLE_TPU_SIGNALS_"
+                        "SPEC flag, then PADDLE_TPU_SLO_SPEC)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between live scrape rounds "
+                        "(default 2)")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="stop the live loop after N rounds "
+                        "(default: run until Ctrl-C)")
+    p.add_argument("--round-s", type=float, default=1.0,
+                   dest="round_s",
+                   help="offline replay round granularity in seconds "
+                        "of ROW time (default 1)")
+    p.add_argument("--incident", action="store_true",
+                   help="render the incident timeline of the given "
+                        "log(s): alert rows spliced with badput "
+                        "intervals and recovery markers")
+    p.add_argument("--json", action="store_true",
+                   help="emit transitions (or the incident entries) "
+                        "as JSON")
+    args = p.parse_args(argv)
+
+    if args.incident:
+        if not args.log:
+            p.error("--incident needs flight-recorder log file(s)")
+        try:
+            entries, ledgers = sg.incident_entries(args.log)
+        except OSError as e:
+            print("alerts: unreadable log: %s" % e, file=sys.stderr)
+            return 2
+        print(json.dumps({"entries": entries}) if args.json
+              else sg.render_incident(entries, ledgers))
+        return 0
+
+    spec_src = args.spec
+    if spec_src is None:
+        from .. import flags
+        spec_src = flags.get_flag("signals_spec") \
+            or flags.get_flag("slo_spec") or None
+    spec = None
+    if spec_src:
+        from .. import slo as _slo
+        try:
+            spec = _slo.load_spec(spec_src)
+        except (OSError, ValueError) as e:
+            print("alerts: bad spec %s: %s" % (spec_src, e),
+                  file=sys.stderr)
+            return 2
+    try:
+        sig = sg.Signals(spec=spec)
+    except ValueError as e:
+        print("alerts: bad rule config: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.fleet is not None or args.endpoint:
+        from .collector import Collector
+        static = []
+        for s in args.endpoint:
+            if "=" not in s:
+                print("alerts: --endpoint wants ROLE=HOST:PORT, got "
+                      "%r" % s, file=sys.stderr)
+                return 2
+            role, ep = s.split("=", 1)
+            static.append((role, ep))
+        col = Collector(kv_endpoint=args.fleet, static=static)
+        rounds = 0
+        n_transitions = 0       # count only: the loop may run for
+        try:                    # weeks, transitions must not pile up
+            while args.rounds is None or rounds < args.rounds:
+                events = col.scrape_once()
+                trs = sig.observe(snapshot=col.fleet_snapshot(),
+                                  events=events)
+                for tr in trs:
+                    print(json.dumps(tr) if args.json
+                          else sg.render_transition(tr))
+                n_transitions += len(trs)
+                rounds += 1
+                if args.rounds is None or rounds < args.rounds:
+                    import time as _time
+                    _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            col.close()
+        if not args.json:
+            hint = sig.scale_hint()
+            print("%d round(s), %d transition(s)   %s\n"
+                  "scale hint: %s x%d  (%s)"
+                  % (rounds, n_transitions,
+                     sg.active_alerts_line(sig).strip(),
+                     hint.direction, hint.magnitude, hint.reason))
+        return 0
+
+    if not args.log:
+        p.error("pass log file(s), or --fleet/--endpoint for the "
+                "live scrape")
+    events = []
+    try:
+        for path in args.log:
+            evs, _ = read_jsonl_tolerant(path)
+            events.extend(evs)
+    except OSError as e:
+        print("alerts: unreadable log: %s" % e, file=sys.stderr)
+        return 2
+    # one log = one process's timeline: the goodput rule evaluates a
+    # rolling ledger per round; a multi-log UNION would collapse
+    # concurrent processes' intervals, so it stays off there (use
+    # watch's per-source rollup for fleets)
+    transitions = sig.replay(events, round_s=args.round_s,
+                             goodput=len(args.log) == 1)
+    if args.json:
+        print(json.dumps({"transitions": transitions,
+                          "active": sig.active(),
+                          "scale_hint": list(sig.scale_hint())}))
+    else:
+        for tr in transitions:
+            print(sg.render_transition(tr))
+        hint = sig.scale_hint()
+        print("%d transition(s)   %s\nscale hint: %s x%d  (%s)"
+              % (len(transitions), sg.active_alerts_line(sig).strip(),
+                 hint.direction, hint.magnitude, hint.reason))
+    return 0
+
+
 def _goodput_main(argv):
     from . import goodput as gp
     p = argparse.ArgumentParser(
@@ -274,10 +428,26 @@ def _goodput_main(argv):
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # `... | head` closed the pipe mid-render: a truncated listing
+        # is what the reader asked for, not a traceback. Re-point
+        # stdout at devnull so the interpreter's exit flush stays
+        # quiet too.
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY),
+                sys.stdout.fileno())
+        return 0
+
+
+def _main(argv):
     if argv and argv[0] == "watch":
         return _watch_main(argv[1:])
     if argv and argv[0] == "goodput":
         return _goodput_main(argv[1:])
+    if argv and argv[0] == "alerts":
+        return _alerts_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.monitor",
         description="Summarize a paddle_tpu.monitor flight-recorder "

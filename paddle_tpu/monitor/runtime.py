@@ -36,7 +36,7 @@ __all__ = [
     "set_tokens_per_step", "on_compile", "on_step", "on_nan_trip",
     "on_retry", "on_reconnect", "on_fault", "on_rollback", "on_resume",
     "on_checkpoint", "on_serving_step", "on_serving_request",
-    "on_spec",
+    "on_spec", "on_alert",
     "on_feed_plan", "on_megastep", "on_transform", "on_sparse_lookup",
     "on_sparse_evictions", "on_sparse_prefetch", "on_sparse_staleness",
     "summary", "session", "prometheus_text", "dump_metrics",
@@ -240,6 +240,17 @@ SPARSE_STALENESS = _REG.histogram(
     "ptpu_sparse_staleness_seconds",
     "read-your-writes staleness: an online update landing on the "
     "pservers -> the first serve whose rows reflect it", ("table",))
+# alerting tier (paddle_tpu.monitor.signals, ISSUE 14): exactly-once
+# FIRING/RESOLVED edges from the streaming rule engine. The counter
+# ticks unconditionally (transitions are rare by hysteresis
+# construction); the gauge is the evaluating process's live count
+ALERT_TRANSITIONS = _REG.counter(
+    "ptpu_alert_transitions_total",
+    "alert state transitions emitted by the monitor.signals rule "
+    "engine", ("rule", "severity", "state"))
+ALERTS_ACTIVE = _REG.gauge(
+    "ptpu_alerts_active",
+    "alerts currently FIRING in this process's signals evaluator")
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -966,6 +977,38 @@ def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,
         if error is not None:
             row["error"] = error
         rec.record("serving_request", **row)
+
+
+def on_alert(rule, severity, state, value=None, figures=None,
+             offenders=None, active=None, at=None):
+    """One alert transition from the monitor.signals rule engine
+    (exactly-once FIRING/RESOLVED edge). Counter ticks
+    unconditionally; the armed recorder lands an ``alert`` row
+    stamped with the triggering windows' figures and the worst
+    offenders in-window — the row the ``monitor alerts --incident``
+    timeline splices with the goodput ledger. The row's ``trace``
+    field carries the FIRST offender's trace id so an alert joins
+    the merged fleet timeline like every other row kind."""
+    ALERT_TRANSITIONS.inc(rule=rule, severity=severity, state=state)
+    if active is not None:
+        ALERTS_ACTIVE.set(active)
+    rec = _S.rec
+    if rec is not None:
+        row = {"rule": rule, "severity": severity, "state": state,
+               "value": value, "figures": figures or {},
+               "offenders": list(offenders or ())}
+        if at is not None:
+            # the transition's LOGICAL time (the evaluation round's
+            # clock) — the recorder stamps its own write-time ts, and
+            # an offline replay's write time is not when the alert
+            # condition held
+            row["at"] = at
+        tr = next((o.get("trace") for o in row["offenders"]
+                   if o.get("trace")), None)
+        if tr is not None:
+            row["trace"] = tr
+        rec.record("alert", **row)
+        rec.flush()
 
 
 def on_feed_plan(hit):

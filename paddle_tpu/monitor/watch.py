@@ -152,25 +152,32 @@ class WatchState:
         elif ev == "devices":
             self.platform = e.get("platform")
 
+    def goodput_rollup(self):
+        """Per-SOURCE rolling ledgers rolled up per process — NEVER a
+        union timeline (two replicas' concurrent productive intervals
+        would collapse into one). The ONE rollup idiom shared by the
+        SLO samples below, the file-mode watch loop, and the fleet
+        loop. None when no timestamped rows have arrived."""
+        if not self.goodput_events:
+            return None
+        from . import goodput as _goodput
+        return _goodput.rollup(
+            _goodput.ledger_from_events(evs)
+            for evs in self.goodput_events.values())
+
     def request_samples(self):
         """SLO-engine-shaped samples over the rolling request window
         (what --slo evaluates live) — delegates to the slo module's
-        one rows->samples extraction. goodput comes from the
-        per-SOURCE raw-event windows rolled up per process (the
-        request/serving deques alone would misattribute a training
-        log and collapse a fleet's concurrent timelines)."""
+        one rows->samples extraction. goodput comes from
+        ``goodput_rollup`` (the request/serving deques alone would
+        misattribute a training log and collapse a fleet's concurrent
+        timelines)."""
         import itertools
         from .. import slo as _slo
-        from . import goodput as _goodput
         out = _slo.samples_from_events(
             itertools.chain(self.requests, self.serving_steps),
             source="watch window", compute_goodput=False)
-        if self.goodput_events:
-            out["goodput"] = _goodput.rollup(
-                _goodput.ledger_from_events(evs)
-                for evs in self.goodput_events.values())
-        else:
-            out["goodput"] = None
+        out["goodput"] = self.goodput_rollup()
         return out
 
 
@@ -288,12 +295,14 @@ def fleet_lines(fleet_snap, now=None):
 
 
 def render_frame(state, path, slo_verdict=None, now=None,
-                 staleness=None, fleet=None):
+                 staleness=None, fleet=None, alerts_line=None):
     """One frame of the dashboard as a string (the ``--once`` / test
     surface; the live loop wraps it in an ANSI clear). ``staleness``:
     {path: last row ts} for the multi-log per-file indicator;
     ``fleet``: a collector fleet snapshot for the scraped-dashboard
-    header."""
+    header; ``alerts_line``: the signals evaluator's ACTIVE ALERTS
+    summary (monitor/signals.py — file mode and --fleet render the
+    same line from the same evaluation shape)."""
     lines = ["paddle_tpu monitor watch — %s   %d events (%s)"
              % (path, state.events, state.platform or "?")]
     if state.last_ts is not None and now is not None:
@@ -421,11 +430,16 @@ def render_frame(state, path, slo_verdict=None, now=None,
         # retries it next refresh)
         health += "   (%d corrupt line(s) skipped)" % state.skipped
     lines.append(health)
+    if alerts_line is not None:
+        lines.append(alerts_line)
     if slo_verdict is not None:
         status = " ".join(
-            "%s %s%s" % ("PASS" if r["pass"] else "FAIL", r["metric"],
+            "%s %s%s" % ("PASS" if r["pass"] else "FAIL",
+                         r["metric"] + (" burn" if r.get("burn")
+                                        else ""),
                          ("=" + _ms(r["measured"]))
                          if r["measured"] is not None
+                         and not r.get("burn")
                          and r["metric"] not in ("error_rate",
                                                  "goodput_fraction")
                          else "")
@@ -454,6 +468,12 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
     if slo_spec:
         from .. import slo as _slo
         spec = _slo.load_spec(slo_spec)
+    # the ACTIVE ALERTS line (ISSUE 14): a local signals evaluation
+    # over the tailed rows — single-process runs get alerting without
+    # a collector. Burn rules arm when the spec carries error-budget
+    # objectives; the sustained-condition defaults always arm.
+    from . import signals as _signals
+    sig = _signals.Signals(spec=spec)
     state = WatchState(window=window)
     tails = [_Tail(p) for p in paths]
     last_ts = {p: None for p in paths}   # per-log staleness indicator
@@ -494,10 +514,27 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
             if spec is not None:
                 from .. import slo as _slo
                 verdict = _slo.evaluate(spec, state.request_samples())
+            led = state.goodput_rollup()
+            if led is not None and led["goodput_fraction"] is not None:
+                # the per-source rollup feeds the goodput_fraction
+                # rule — spec or no spec, the alerts line gets it
+                sig.feed_sample("goodput_fraction",
+                                led["goodput_fraction"],
+                                now=state.last_ts)
+            if once:
+                # deterministic offline evaluation on the log's own
+                # clock: rows grouped into 1 s rounds, so alerts the
+                # history SHOULD have fired are active in the frame
+                sig.replay([e for e, _ in events])
+            else:
+                sig.feed_events([e for e, _ in events])
+                sig.evaluate(now=time.time())
             frame = render_frame(state, label, slo_verdict=verdict,
                                  now=None if once else time.time(),
                                  staleness=last_ts
-                                 if len(paths) > 1 else None)
+                                 if len(paths) > 1 else None,
+                                 alerts_line=_signals
+                                 .active_alerts_line(sig))
             if once:
                 out.write(frame + "\n")
                 return frame
@@ -538,17 +575,25 @@ def watch_fleet(kv_endpoint=None, static=(), interval=2.0, window=256,
     col = collector if collector is not None else Collector(
         kv_endpoint=kv_endpoint, static=static)
     own_col = collector is None
+    from . import signals as _signals
+    sig = _signals.Signals(spec=spec)
     state = WatchState(window=window)
     label = kv_endpoint or ", ".join(ep for _, ep in static) \
         or "scrape"
     frames = 0
     try:
         while True:
-            for e in col.scrape_once():
+            round_events = col.scrape_once()
+            for e in round_events:
                 # scraped rows carry proc = "role@endpoint": the
                 # per-process key the rolling goodput rollup needs
                 state.feed_event(e, source=e.get("proc") or "")
             snap = col.fleet_snapshot()
+            # signals round: the merged snapshot feeds the counter
+            # series (incarnation-aware), the scraped rows feed
+            # samples + offender correlation, then one evaluation
+            sig.feed_snapshot(snap)
+            sig.feed_events(round_events)
             verdict = None
             if spec is not None:
                 from .. import slo as _slo
@@ -565,10 +610,19 @@ def watch_fleet(kv_endpoint=None, static=(), interval=2.0, window=256,
                     fallback["goodput"] = samples.get("goodput")
                     samples = fallback
                 verdict = _slo.evaluate(spec, samples)
+            led = state.goodput_rollup()
+            if led is not None and led["goodput_fraction"] is not None:
+                # same per-source rollup discipline as file mode —
+                # the goodput rule is armed with or without a spec
+                sig.feed_sample("goodput_fraction",
+                                led["goodput_fraction"])
+            sig.evaluate()
             frame = render_frame(state, "fleet %s" % label,
                                  slo_verdict=verdict,
                                  now=None if once else time.time(),
-                                 fleet=snap)
+                                 fleet=snap,
+                                 alerts_line=_signals
+                                 .active_alerts_line(sig))
             if once:
                 from .metrics import META_KEY
                 eps = (snap.get(META_KEY) or {}).get("endpoints") or []

@@ -26,7 +26,13 @@ Comparison rules (the part a naive differ gets wrong):
     (a config that failed its repeats must not read as a
     regression), and rounds from different PLATFORMS never compare
     (a CPU rehearsal round vs a chip round would scream regression
-    on every probe).
+    on every probe),
+  * an UNSTAMPED round (no ``platform`` field — the pre-r06 records)
+    is the one platform-AMBIGUOUS pairing: the mismatch guard cannot
+    fire, so a chip round could silently compare against a CPU
+    baseline. The CLI warns loudly whenever either side lacks the
+    stamp, and ``--require-platform-stamp`` turns that warning into
+    exit 1 — the chip round's CI should pass it.
 
 CLI::
 
@@ -316,6 +322,14 @@ def main(argv=None):
                         "passes with a loud stderr warning, since a "
                         "CPU rehearsal gated against a chip baseline "
                         "is legitimate")
+    p.add_argument("--require-platform-stamp", action="store_true",
+                   help="fail (exit 1) unless BOTH sides carry a "
+                        "'platform' stamp. An unstamped pre-r06 "
+                        "baseline is the one platform-AMBIGUOUS "
+                        "pairing (the mismatch guard cannot fire), "
+                        "so a chip round could silently gate against "
+                        "a CPU record — chip-round CI should pass "
+                        "this")
     p.add_argument("--json", action="store_true",
                    help="emit the verdict as one JSON object")
     args = p.parse_args(argv)
@@ -335,8 +349,24 @@ def main(argv=None):
         print("perfgate: bad input: %s" % e, file=sys.stderr)
         return 2
     verdict["baseline"] = str(baseline)
+    unstamped = [side for side, plat in
+                 (("current", verdict["platform"]),
+                  ("baseline", verdict["baseline_platform"]))
+                 if plat is None]
     print(json.dumps(verdict) if args.json else
           render(verdict) + "\n  baseline: %s" % baseline)
+    if unstamped:
+        print("perfgate: WARNING — %s side(s) carry no 'platform' "
+              "stamp (pre-r06 round?): this comparison is "
+              "platform-AMBIGUOUS — the CPU-vs-chip mismatch guard "
+              "cannot fire, so these deltas may compare different "
+              "hardware. Re-stamp the round (bench.py stamps "
+              "platform since r06) or pass an explicit stamped "
+              "baseline." % " and ".join(unstamped), file=sys.stderr)
+        if args.require_platform_stamp:
+            print("perfgate: --require-platform-stamp set — gate "
+                  "FAILED on the ambiguous pairing", file=sys.stderr)
+            return 1
     if verdict["compared"] < args.min_compared:
         print("perfgate: only %d probe(s) compared < --min-compared "
               "%d — gate FAILED" % (verdict["compared"],

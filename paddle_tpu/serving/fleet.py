@@ -67,7 +67,9 @@ against the SLO error budget (a ``serving_request`` row with the error
 lands under the router's engine label).
 
 Telemetry: ``ptpu_fleet_{replicas,requests,resubmissions,shed,
-evictions,duplicate_results}_*`` metrics; ``router.dispatch`` spans
+evictions,duplicate_results}_*`` metrics plus the live
+``ptpu_fleet_queue_depth`` gauge (the standing dispatch-queue depth
+monitor.signals' queue-pressure rule reads); ``router.dispatch`` spans
 (rid / slot / endpoint attrs — a resubmitted id shows two dispatch
 spans with different endpoints, the resubmission hop ``trace merge``
 renders) nesting the ``fleet.subm`` client verb span whose context
@@ -127,6 +129,14 @@ FLEET_SHED = _REG.counter(
     "ptpu_fleet_shed_total",
     "requests fast-failed (Overloaded) at the global queue bound",
     ("router",))
+# live pressure gauge (ISSUE 14): the shed counter records the DROPS,
+# this gauge the router's standing dispatch-queue depth — the
+# queue-pressure input monitor.signals' sustained rules and the
+# direction-2 autoscaling scale_hint() read (previously counters-only,
+# so "is the queue deep RIGHT NOW" was not scrapeable)
+FLEET_QUEUE_DEPTH = _REG.gauge(
+    "ptpu_fleet_queue_depth",
+    "requests waiting in the router's dispatch queue", ("router",))
 FLEET_EVICTIONS = _REG.counter(
     "ptpu_fleet_evictions_total",
     "replicas evicted from dispatch", ("reason",))
@@ -788,6 +798,7 @@ class Router:
             self._queue.append(rid)
             self.stats["requests"] += 1
             FLEET_REQUESTS.inc(router=self.name)
+            FLEET_QUEUE_DEPTH.set(len(self._queue), router=self.name)
             self._cv.notify_all()
         return handle
 
@@ -838,6 +849,7 @@ class Router:
             replicas = list(self._replicas.values())
             self._replicas = {}
             self._queue.clear()
+            FLEET_QUEUE_DEPTH.set(0, router=self.name)
         for e in pending:
             self._fail_entry(e, RuntimeError("router closed"))
         for r in replicas:
@@ -940,6 +952,7 @@ class Router:
         self._queue.appendleft(rid)
         self.stats["resubmissions"] += 1
         FLEET_RESUBMISSIONS.inc(router=self.name)
+        FLEET_QUEUE_DEPTH.set(len(self._queue), router=self.name)
         self._cv.notify_all()
 
     # -- replica lifecycle -------------------------------------------------
@@ -1027,9 +1040,19 @@ class Router:
                     # drop stale heads: an entry a slow replica's late
                     # result completed WHILE it sat requeued must not
                     # be re-executed (its state already left _QUEUED)
+                    dropped = False
                     while self._queue and self._journal[
                             self._queue[0]]["state"] != _QUEUED:
                         self._queue.popleft()
+                        dropped = True
+                    if dropped:
+                        # every queue mutation updates the gauge — a
+                        # queue drained entirely by stale-head drops
+                        # must not leave a phantom depth pinning the
+                        # signals queue alert (and blocking its
+                        # scale-down hint) forever
+                        FLEET_QUEUE_DEPTH.set(len(self._queue),
+                                              router=self.name)
                     if self._queue:
                         loads = {s: len(self._inflight.get(s, ()))
                                  for s in self._replicas}
@@ -1040,6 +1063,8 @@ class Router:
                             affinity=self._affinity)
                         if slot is not None:
                             rid = self._queue.popleft()
+                            FLEET_QUEUE_DEPTH.set(
+                                len(self._queue), router=self.name)
                             break
                     self._cv.wait(timeout=0.25)
                 if rid is None:

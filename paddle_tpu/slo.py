@@ -51,6 +51,25 @@ attribution — productive seconds over measured wall — computed from
 the same recorder rows (a HIGHER-is-better objective: ``min_ratio``
 is the floor). Row surfaces only, like kv_used_blocks.
 
+Error-budget form (ISSUE 14): any objective over ``error_rate`` or a
+latency metric may instead declare a target fraction plus multi-window
+burn-rate pairs (the SRE-Workbook ch. 5 shape the streaming alerting
+tier in ``monitor/signals.py`` evaluates live)::
+
+    {"metric": "error_rate", "target": 0.999, "windows": [
+        {"short_s": 300,  "long_s": 3600,  "burn_rate": 14.4,
+         "severity": "page"},
+        {"short_s": 1800, "long_s": 21600, "burn_rate": 6.0,
+         "severity": "ticket"}]}
+
+Latency metrics add ``max_seconds`` (what counts as a good event).
+The burn rate over a window is ``bad_fraction / (1 - target)``; the
+objective FAILS when any pair exceeds its ``burn_rate`` in BOTH
+windows at the newest recorded timestamp. Window pairs are validated
+at spec load (short_s < long_s, positive rates — exit 2 on
+violation). Row surfaces only: the batch verdict needs timestamped
+request rows, which --spans/--metrics do not carry.
+
 An objective with NO samples fails (a run that measured nothing cannot
 claim an SLO was met) and says so in its reason. CLI::
 
@@ -105,6 +124,14 @@ LATENCY_METRICS = {
 GAUGE_METRICS = ("kv_used_blocks",)
 
 
+def _signals():
+    # lazy: the burn math lives with the streaming alerting tier
+    # (monitor/signals.py) so the batch verdict here and the live
+    # evaluator can never drift
+    from .monitor import signals
+    return signals
+
+
 def load_spec(source):
     """Parse + validate a spec (path, JSON string, or dict). Raises
     ValueError on schema violations — a malformed gate spec must fail
@@ -120,9 +147,24 @@ def load_spec(source):
     objectives = spec.get("objectives")
     if not isinstance(objectives, list) or not objectives:
         raise ValueError("SLO spec needs a non-empty 'objectives' list")
+    if spec.get("rules") is not None:
+        # the signals rule overrides validate HERE, the one spec
+        # choke point — every consumer (watch's alerts line, the
+        # alerts CLI, a supervisor embedding Signals) gets the same
+        # loud load-time failure instead of a traceback out of its
+        # own loop
+        _signals().build_rules({"rules": spec["rules"],
+                                "objectives": []})
     for i, obj in enumerate(objectives):
         metric = obj.get("metric")
-        if metric == "error_rate":
+        if _signals().is_budget_objective(obj):
+            # error-budget form (ISSUE 14): target fraction + burn
+            # window pairs, validated loudly at load — including
+            # short_s < long_s on every pair
+            _signals().validate_budget_objective(
+                obj, i, known_metrics=("error_rate",)
+                + tuple(LATENCY_METRICS))
+        elif metric == "error_rate":
             if not isinstance(obj.get("max_ratio"), (int, float)):
                 raise ValueError(
                     "objective %d (error_rate) needs numeric "
@@ -169,7 +211,8 @@ def _empty_samples(source):
     return {"source": source, "requests": 0, "errors": 0,
             "ttft": [], "tpot": [], "queue_wait": [],
             "step_latency": [], "kv_used_blocks": [],
-            "staleness_s": [],
+            "staleness_s": [], "request_rows": [],
+            "timed_samples": {},
             "goodput": None, "histograms": {}, "skipped": 0}
 
 
@@ -196,10 +239,26 @@ def samples_from_events(events, source="events",
         from .monitor import goodput as _goodput
         events = list(events)
         out["goodput"] = _goodput.ledger_from_events(events)
+    def _timed(metric, ts, v):
+        # timestamped per-metric samples back the error-budget burn
+        # math for LATENCY metrics (error_rate burns over
+        # request_rows) — every metric a budget spec may name gets a
+        # window-countable series, matching the live evaluator
+        out["timed_samples"].setdefault(metric, []).append(
+            (float(ts), float(v)))
+
     for e in events:
         ev = e.get("ev")
         if ev == "serving_request":
             out["requests"] += 1
+            if e.get("ts") is not None:
+                # timestamped row triple for the error-budget burn
+                # math (monitor/signals.burn_pairs — the ONE window
+                # arithmetic the live evaluator shares)
+                out["request_rows"].append(
+                    (float(e["ts"]), bool(e.get("error")),
+                     {k: e.get(k) for k in ("ttft", "tpot",
+                                            "queue_wait")}))
             if e.get("error"):
                 # error-budget business only: a failed request's retire
                 # stamp is the failure time (kill/wedge gap), and its
@@ -210,15 +269,21 @@ def samples_from_events(events, source="events",
             for k in ("ttft", "tpot", "queue_wait"):
                 if e.get(k) is not None:
                     out[k].append(float(e[k]))
+                    if e.get("ts") is not None:
+                        _timed(k, e["ts"], e[k])
         elif ev == "serving_step":
             if e.get("dt") is not None:
                 out["step_latency"].append(float(e["dt"]))
+                if e.get("ts") is not None:
+                    _timed("step_latency", e["ts"], e["dt"])
             if e.get("kv_used_blocks") is not None:
                 out["kv_used_blocks"].append(
                     float(e["kv_used_blocks"]))
         elif ev == "sparse_staleness":
             if e.get("value") is not None:
                 out["staleness_s"].append(float(e["value"]))
+                if e.get("ts") is not None:
+                    _timed("staleness_s", e["ts"], e["value"])
     return out
 
 
@@ -334,7 +399,63 @@ def evaluate(spec, samples):
     results = []
     for obj in spec["objectives"]:
         metric = obj["metric"]
-        if metric == "error_rate":
+        if _signals().is_budget_objective(obj):
+            # error-budget burn verdict at the newest recorded
+            # timestamp — the batch twin of the live alerting tier,
+            # sharing its exact row-window math
+            if metric == "error_rate":
+                rows = samples.get("request_rows") or []
+            else:
+                # latency burn: good/bad over the metric's own
+                # timestamped samples (the shape the live evaluator's
+                # row mode uses), so staleness_s / step_latency budget
+                # specs evaluate instead of failing "no samples"
+                rows = [(ts, False, {metric: v}) for ts, v in
+                        (samples.get("timed_samples") or {})
+                        .get(metric, ())]
+            now = max((r[0] for r in rows), default=None)
+            ent = {"metric": metric, "burn": True,
+                   "threshold": min(float(w["burn_rate"])
+                                    for w in obj["windows"]),
+                   "approximate": False}
+            if now is None:
+                ent.update({"measured": None, "count": 0,
+                            "pass": False,
+                            "reason": "no timestamped request rows "
+                                      "on this surface"})
+            else:
+                pairs = _signals().burn_pairs(obj, rows, now)
+                fired = [p for p in pairs if p["fired"]]
+                # measured = the worst pair's min(burn_short,
+                # burn_long) against ITS OWN rate — the figure the
+                # fire condition actually gates (both windows must
+                # exceed), so measured < threshold on a PASS line and
+                # measured >= threshold on a FAIL line by
+                # construction; fired pairs win the display
+                def _score(p):
+                    return min(p["burn_short"], p["burn_long"])
+                scored = [p for p in (fired or pairs)
+                          if p["burn_short"] is not None
+                          and p["burn_long"] is not None]
+                worst = max(scored, key=_score) if scored else None
+                ent.update({
+                    "measured": _score(worst) if worst else None,
+                    "threshold": worst["burn_rate"] if worst
+                    else ent["threshold"],
+                    "count": max(p["n_long"] for p in pairs),
+                    "windows": pairs,
+                    "pass": not fired})
+                if fired:
+                    ent["reason"] = "burn >= %s in %s" % (
+                        ", ".join("%g" % p["burn_rate"]
+                                  for p in fired),
+                        ", ".join("%gs/%gs" % (p["short_s"],
+                                               p["long_s"])
+                                  for p in fired))
+                elif ent["measured"] is None:
+                    ent.update({"pass": False,
+                                "reason": "no samples in any window"})
+        elif metric == "error_rate":
             threshold = float(obj["max_ratio"])
             n = samples.get("requests", 0)
             measured = (samples.get("errors", 0) / n) if n else None
@@ -398,9 +519,11 @@ def evaluate(spec, samples):
             "objectives": results}
 
 
-def _fmt(metric, v):
+def _fmt(metric, v, burn=False):
     if v is None:
         return "n/a"
+    if burn:
+        return "%.2fx" % v
     if metric in ("error_rate", "goodput_fraction"):
         return "%.2f%%" % (100.0 * v)
     if metric in GAUGE_METRICS:
@@ -417,13 +540,18 @@ def render(verdict):
     lines = [head]
     for r in verdict["objectives"]:
         label = r["metric"]
-        if "percentile" in r:
+        if r.get("burn"):
+            label += " burn"
+        elif "percentile" in r:
             label += " p%g" % (100.0 * r["percentile"])
-        cmp_ = ">=" if r["metric"] == "goodput_fraction" else "<="
+        cmp_ = ">=" if r["metric"] == "goodput_fraction" else "<"
+        if r["metric"] != "goodput_fraction" and not r.get("burn"):
+            cmp_ = "<="
         line = "  %-4s %-18s %9s %s %-9s (n=%d%s)" % (
             "PASS" if r["pass"] else "FAIL", label,
-            _fmt(r["metric"], r["measured"]), cmp_,
-            _fmt(r["metric"], r["threshold"]), r["count"],
+            _fmt(r["metric"], r["measured"], r.get("burn")), cmp_,
+            _fmt(r["metric"], r["threshold"], r.get("burn")),
+            r["count"],
             ", approx" if r.get("approximate") else "")
         if r.get("reason"):
             line += "  [%s]" % r["reason"]

@@ -133,3 +133,37 @@ def test_load_result_historic_round_shapes():
     assert perfgate.load_result(rec)["value"] == 3
     with pytest.raises(ValueError, match="metric"):
         perfgate.load_result({"nope": 1})
+
+
+# -- platform-stamp ambiguity guard (ISSUE 14 satellite) -------------------
+
+def _unstamped(tmp_path, name="BENCH_r02.json"):
+    """A pre-r06-shaped round with NO platform stamp — the one
+    platform-AMBIGUOUS pairing (the mismatch guard cannot fire)."""
+    base = perfgate.load_result(BASE)
+    rec = json.loads(json.dumps(base))
+    rec.pop("platform", None)
+    path = tmp_path / name
+    path.write_text(json.dumps({"result": rec}))
+    return str(path)
+
+
+def test_unstamped_baseline_warns_loudly(tmp_path):
+    old = _unstamped(tmp_path)
+    rc, out, err = _cli(BASE, old)
+    assert rc == 0                        # advisory by default: the
+    assert "platform-AMBIGUOUS" in err    # warning is loud, the CPU
+    assert "baseline" in err              # rehearsal keeps passing
+    # stamped-vs-stamped comparisons stay silent
+    rc2, _, err2 = _cli(BASE, BASE)
+    assert rc2 == 0 and "AMBIGUOUS" not in err2
+
+
+def test_require_platform_stamp_gates_chip_ci(tmp_path):
+    old = _unstamped(tmp_path)
+    rc, _, err = _cli(BASE, old, "--require-platform-stamp")
+    assert rc == 1
+    assert "--require-platform-stamp" in err
+    # both sides stamped: the flag is satisfied (CPU self-compare)
+    rc2, _, _ = _cli(BASE, BASE, "--require-platform-stamp")
+    assert rc2 == 0
