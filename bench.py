@@ -782,6 +782,107 @@ def main():
     transform_summary = guarded("transform-probe", transform_probe,
                                 errors)
 
+    def specialize_probe():
+        """ISSUE-15 specialize probe, CPU-pinned (process-level pin —
+        the engine decode loop is a background thread): (a) per-zoo-
+        model fusion-pattern hits from the full optimizing pipeline;
+        (b) artifact cold-boot wall — save_inference_model ->
+        fresh-scope load -> parameter-stream replay into the decode
+        model; (c) interleaved A/B serving tok/s of the artifact-booted
+        engine vs the source-model engine, with the token-identity
+        verdict (the ISSUE acceptance A/B: specialization must not
+        regress serving)."""
+        import shutil
+        import tempfile
+        import jax
+        import numpy as np
+        from paddle_tpu import serving
+        from paddle_tpu.models import TRANSFORM_ZOO, transform_zoo_entry
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.models.transformer_infer import TransformerLMInfer
+        from paddle_tpu.transform import PassManager, default_passes
+        prev = jax.config.jax_default_device
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        eng_src = eng_art = None
+        art = None
+        try:
+            fused = {}
+            for name in sorted(TRANSFORM_ZOO):
+                main, _, _, fetch_names = transform_zoo_entry(name)
+                res = PassManager(default_passes()).run(
+                    main, keep=fetch_names)
+                fused[name] = sum(v for v in res.patterns.values())
+            zoo_fused_total = sum(fused.values())
+
+            _fresh()
+            main, startup = (fluid.default_main_program(),
+                             fluid.default_startup_program())
+            scope = fluid.global_scope()
+            avg_cost, logits = T.transformer_lm(
+                vocab_size=64, max_len=96, n_layer=2, n_head=2,
+                d_model=64, d_inner=128)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            lm = TransformerLMInfer(main, scope, 2, 2, 64, 96)
+            art = tempfile.mkdtemp(prefix="ptpu_artifact_")
+            serving.save_lm_artifact(art, main, scope, [logits],
+                                     2, 2, 64, 96)
+            t0 = time.perf_counter()
+            model2 = serving.model_from_artifact(art)
+            boot_s = time.perf_counter() - t0
+
+            eng_src = serving.Engine(lm, slots=4, prefill_chunk=8,
+                                     name="spec-src")
+            eng_art = serving.Engine(model2, slots=4, prefill_chunk=8,
+                                     name="spec-art")
+            rng = np.random.RandomState(0)
+            prompts = [[1] + rng.randint(3, 64,
+                                         int(rng.randint(1, 10))).tolist()
+                       for _ in range(12)]
+
+            def win(e):
+                t0 = time.perf_counter()
+                outs = e.generate_many(prompts, 24)
+                toks = sum(len(t) for t, _ in outs)
+                return (toks / (time.perf_counter() - t0),
+                        [t for t, _ in outs])
+
+            win(eng_src), win(eng_art)          # warm both compiles
+            a, b, identical = [], [], True
+            for _ in range(3):                  # interleaved A/B
+                sa, ta = win(eng_src)
+                sb, tb = win(eng_art)
+                a.append(sa)
+                b.append(sb)
+                identical = identical and (ta == tb)
+            m0, sp0, s0 = agg(a, nd=0)
+            m1, sp1, s1 = agg(b, nd=0)
+            probe = {
+                "zoo_fused_ops": fused,
+                "zoo_fused_total": zoo_fused_total,
+                "config": "transformer_lm 2L/d64 T96, 12 mixed reqs "
+                          "x24 new, slots=4 (CPU pin)",
+                "artifact_boot_s": round(boot_s, 3),
+                "source_tok_s": round(m0),
+                "source_spread_pct": sp0,
+                "artifact_tok_s": round(m1),
+                "artifact_spread_pct": sp1,
+                "serving_delta_pct": round(100.0 * (m1 - m0) / m0, 1),
+                "identical": identical,
+            }
+            print("specialize probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            for e in (eng_src, eng_art):
+                if e is not None:
+                    e.close()
+            if art is not None:
+                shutil.rmtree(art, ignore_errors=True)
+            jax.config.update("jax_default_device", prev)
+
+    specialize_summary = guarded("specialize-probe", specialize_probe,
+                                 errors)
+
     def alerts_probe():
         """ISSUE-14 signal-plane probe: an ARMED mini-fleet (private
         registry behind a real TelemetryServer, scraped by a real
@@ -908,6 +1009,13 @@ def main():
         # A/B on the dispatch-bound train shape, and the autoparallel
         # planner's top-3 for the transformer zoo model at 8 devices
         out["transform"] = transform_summary
+    if specialize_summary is not None:
+        # inference-specialization stamp (ISSUE 15): per-zoo-model
+        # fusion-pattern hits, artifact cold-boot wall, and the
+        # artifact-vs-source serving A/B with token identity — the
+        # perfgate-gated non-regression contract of the specialize
+        # pipeline
+        out["specialize"] = specialize_summary
     if fleet_summary is not None:
         # serving-fleet stamp (ISSUE 8): disarmed router overhead
         # (interleaved A/B vs direct engine, per-request p50/p95 added

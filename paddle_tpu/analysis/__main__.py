@@ -39,7 +39,12 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.slo",
     "paddle_tpu.transform",
     "paddle_tpu.transform.passes",
+    "paddle_tpu.transform.fusion",
+    "paddle_tpu.transform.infer",
+    "paddle_tpu.transform.memory",
+    "paddle_tpu.transform.calibrate",
     "paddle_tpu.transform.autoparallel",
+    "paddle_tpu.serving.artifact",
     "paddle_tpu.trace",
     "paddle_tpu.trace.runtime",
     "paddle_tpu.trace.clock",

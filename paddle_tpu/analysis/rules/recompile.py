@@ -32,6 +32,33 @@ class RecompileHazardRule(Rule):
         self.const_min_bytes = const_min_bytes
         self.scalar_flood = scalar_flood
 
+    def _check_fused_scopes(self, a):
+        """Fused-op awareness (ISSUE 15): the transform tier's pattern
+        fusion rewrites op chains into single ops whose lowerings run
+        under ONE ``<fused_type>.<seq>`` named scope — when this rule
+        reports an op path inside such a scope, the reader should
+        attribute it to the fusion tier's output, not a mystery op.
+        One INFO summarizes the fused scopes present."""
+        from ...ops.fused import FUSED_OP_TYPES
+        scopes = {}
+        for view, eqn in a.iter_eqns():
+            ns = str(eqn.source_info.name_stack)
+            for part in ns.split("/"):
+                base = part.rsplit(".", 1)[0]
+                if base in FUSED_OP_TYPES:
+                    scopes.setdefault(base, set()).add(part)
+        if not scopes:
+            return
+        yield Diagnostic(
+            self.name, INFO,
+            "%d fused-op scope(s) from transform.fusion (%s) — each "
+            "is ONE op-path/compile unit; op paths under them "
+            "attribute to the fusion tier's rewrite, and their "
+            "component chain can no longer fragment individually"
+            % (sum(len(v) for v in scopes.values()),
+               ", ".join("%s x%d" % (t, len(v))
+                         for t, v in sorted(scopes.items()))))
+
     def _check_scanned_units(self, a):
         """Each lax.scan body is one compile unit keyed on its trip
         count K: megastep execution (Executor.run_steps, the serving
@@ -95,6 +122,8 @@ class RecompileHazardRule(Rule):
                     hint="pass it as a function argument (donated "
                          "state) instead of closing over it")
         for d in self._check_scanned_units(a):
+            yield d
+        for d in self._check_fused_scopes(a):
             yield d
         # informational: how much of the signature is traced state
         yield Diagnostic(

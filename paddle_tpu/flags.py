@@ -333,12 +333,20 @@ _register("transform", bool, False,
 _register("transform_passes", str, "all",
           "which optimizing passes the armed transform (and the "
           "python -m paddle_tpu.transform CLI default) runs: 'all', "
-          "'none', or a comma list from {constant_fold, cse, dead_op} "
-          "in application order")
+          "'none', or a comma list from {constant_fold, cse, dead_op, "
+          "fusion, bf16_cast} in application order ('all' excludes "
+          "the opt-in, non-bitwise bf16_cast)")
 _register("autoparallel_devices", int, 0,
           "default device count for the automatic parallelism planner "
           "(python -m paddle_tpu.transform --plan / "
           "transform.recommend); 0 = jax.device_count() at call time")
+_register("autoparallel_calib", str, "",
+          "path to a transform.calibrate calibration record "
+          "(python -m paddle_tpu.transform --calibrate); when set, "
+          "plan_cost prices candidates with the MEASURED per-chip "
+          "matmul FLOP/s and ring-collective bandwidth instead of the "
+          "documented placeholders. Empty / unreadable = placeholders "
+          "(rankings stay ordinal, one stderr warning per bad path)")
 _register("autoparallel_hbm_gb", float, 0.0,
           "per-chip HBM capacity (GB) the autoparallel planner "
           "filters against: candidates whose modeled per-chip bytes "

@@ -52,6 +52,11 @@ def zoo_entry(name):
 TRANSFORM_ZOO = {
     "mlp": ("paddle_tpu.models.mlp", "zoo_spec"),
     "cnn": ("paddle_tpu.models.mlp", "zoo_spec_cnn"),
+    # composed inference pipeline (ISSUE 15): in-graph uint8
+    # normalization (cast+scale), inter-module layout converts
+    # (inverse transposes), flatten-then-regroup (reshape chain) —
+    # each fusion pattern's zoo shrink target. Program-zoo only.
+    "cnn_infer": ("paddle_tpu.models.mlp", "zoo_spec_cnn_infer"),
     "resnet": ("paddle_tpu.models.resnet", "zoo_spec"),
     "vgg": ("paddle_tpu.models.vgg", "zoo_spec"),
     "ssd": ("paddle_tpu.models.ssd", "zoo_spec"),

@@ -63,6 +63,36 @@ def zoo_spec_cnn():
     return build, feeds
 
 
+def zoo_spec_cnn_infer():
+    """(build_fn, feed_fn) for a COMPOSED inference pipeline — the
+    op-chain shapes real deployments accumulate at module seams, which
+    the fusion tier exists to erase (ISSUE 15): a raw uint8 image feed
+    normalized in-graph (cast -> scale), a conv stage whose producer
+    exports NHWC while the consumer expects NCHW (inverse transposes),
+    and a flatten the consumer immediately regroups (reshape of a
+    reshape), ending in the fc softmax head. Program-zoo only: its
+    traced twin would duplicate the CNN's analysis coverage."""
+    def build():
+        img = fluid.layers.data("img", [1, 28, 28], dtype="uint8")
+        x = fluid.layers.cast(img, "float32")
+        x = fluid.layers.scale(x, scale=1.0 / 255.0)
+        conv = fluid.layers.conv2d(x, num_filters=8, filter_size=5,
+                                   act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+        nhwc = fluid.layers.transpose(pool, [0, 2, 3, 1])
+        nchw = fluid.layers.transpose(nhwc, [0, 3, 1, 2])
+        flat = fluid.layers.reshape(nchw, [-1, 8 * 12 * 12])
+        grouped = fluid.layers.reshape(flat, [-1, 8, 144])
+        pred = fluid.layers.fc(grouped, 10, act="softmax")
+        return (pred,)
+
+    def feeds(rng):
+        return {"img": rng.randint(
+            0, 256, (4, 1, 28, 28)).astype("uint8")}
+
+    return build, feeds
+
+
 def analysis_entry():
     """Static-analyzer entry: MLP Adam train step (see models/harness)."""
     from .harness import program_entry

@@ -36,11 +36,27 @@ _PARAM_OPS = {
 def extract_params(program, scope):
     """Walk the program's ops in order; yield (role, arrays) for every op
     that consumes a persistable parameter. This is the bridge from the
-    Program IR to the pure-JAX inference model."""
+    Program IR to the pure-JAX inference model.
+
+    Transform-specialized programs (ISSUE 15) are first-class inputs: a
+    ``fused_matmul_bias_act`` op emits its anchor's "mul" role and its
+    "bias" role at the SAME stream position the unfused chain would
+    have — a fused artifact replays into the identical parameter
+    stream."""
     gb = program.global_block()
     persistable = {v.name for v in gb.vars.values() if v.persistable}
+
+    def _take(names):
+        return jnp.asarray(scope.find_var(names[0]))
+
     out = []
     for op in gb.ops:
+        if op.type == "fused_matmul_bias_act":
+            for role, names in (("mul", op.input("Y")),
+                                ("bias", op.input("Bias"))):
+                if names and names[0] in persistable:
+                    out.append((role, [_take(names)]))
+            continue
         if op.type not in _PARAM_OPS:
             continue
         role, slot = _PARAM_OPS[op.type]
@@ -52,7 +68,7 @@ def extract_params(program, scope):
         names = op.input(slot)
         if not names or names[0] not in persistable:
             continue  # residual adds etc.
-        out.append((role, [jnp.asarray(scope.find_var(names[0]))]))
+        out.append((role, [_take(names)]))
     return out
 
 

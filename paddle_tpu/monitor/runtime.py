@@ -211,6 +211,10 @@ TRANSFORM_PASSES = _REG.counter(
 TRANSFORM_OPS_REMOVED = _REG.counter(
     "ptpu_transform_ops_removed_total",
     "ops removed or rewritten by an optimizing pass", ("pass",))
+TRANSFORM_PATTERNS = _REG.counter(
+    "ptpu_transform_patterns_total",
+    "fusion-pattern hits by pattern name (transform/fusion.py)",
+    ("pattern",))
 # sparse serving tier (paddle_tpu.serving.sparse, ISSUE 12): the hot-ID
 # embedding cache in front of the live pserver shards, and the online-
 # learning loop's read-your-writes staleness. Counters tick
@@ -1017,29 +1021,36 @@ def on_feed_plan(hit):
 
 
 def on_transform(program, pass_name, ops_before, ops_after, dt,
-                 changes=None):
+                 changes=None, patterns=None):
     """One optimizing-pass rewrite phase over a Program completed
     (paddle_tpu.transform.PassManager). ``changes`` is the pass's own
     removed-or-rewritten count — constant folding REPLACES ops in
-    place, so the op-count delta alone would hide its work. Counters
-    tick unconditionally (transforms run per compile, not per step);
-    the armed recorder additionally lands a ``transform`` row —
-    program id, pass, ops before/after, wall time — following the
-    PR-2 row conventions."""
+    place, so the op-count delta alone would hide its work.
+    ``patterns`` (the fusion pass) maps pattern name -> hits for this
+    phase. Counters tick unconditionally (transforms run per compile,
+    not per step); the armed recorder additionally lands a
+    ``transform`` row — program id, pass, ops before/after, wall time
+    — following the PR-2 row conventions."""
     removed = int(changes) if changes is not None \
         else max(0, int(ops_before) - int(ops_after))
     TRANSFORM_PASSES.inc(**{"pass": pass_name})
     if removed:
         TRANSFORM_OPS_REMOVED.inc(removed, **{"pass": pass_name})
+    if patterns:
+        for pat, n in patterns.items():
+            if n:
+                TRANSFORM_PATTERNS.inc(int(n), pattern=pat)
     if not _S.on:
         return
     rec = _S.rec
     if rec is not None:
+        row = {"pass": pass_name, "ops_before": int(ops_before),
+               "ops_after": int(ops_after), "removed": removed,
+               "dt": dt}
+        if patterns:
+            row["patterns"] = {k: v for k, v in patterns.items() if v}
         rec.record("transform", program=id(program),
-                   version=getattr(program, "_version", None),
-                   **{"pass": pass_name, "ops_before": int(ops_before),
-                      "ops_after": int(ops_after), "removed": removed,
-                      "dt": dt})
+                   version=getattr(program, "_version", None), **row)
 
 
 _mem_sample_counter = [0]

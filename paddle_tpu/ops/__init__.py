@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     crf_ctc,
     detection_ops,
     elementwise,
+    fused,
     rnn_ops,
     loss,
     math,

@@ -139,6 +139,19 @@ PROBES = (
     Probe("recsys_router_overhead_pct",
           ("recsys", "router_overhead_pct"), "lower", 15.0,
           band_abs=10.0),
+    # inference-specialization probes (ISSUE 15): the artifact-booted
+    # engine's serving tok/s must not regress vs prior rounds (the
+    # source-engine A/B rides the same stamp), the artifact cold-boot
+    # wall must stay bounded (the direction-2 replica-respawn cost),
+    # and the zoo-wide fusion hit count is a deterministic coverage
+    # floor — fewer hits means a pattern stopped matching. Missing on
+    # pre-15 baselines -> skip, like the spec/recsys probes
+    Probe("specialize_art_tok_s", ("specialize", "artifact_tok_s"),
+          "higher", 30.0, ("specialize", "artifact_spread_pct")),
+    Probe("specialize_boot_s", ("specialize", "artifact_boot_s"),
+          "lower", 50.0),
+    Probe("specialize_zoo_fused", ("specialize", "zoo_fused_total"),
+          "higher", 5.0),
 )
 
 

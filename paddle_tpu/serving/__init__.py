@@ -75,8 +75,11 @@ from .kvpool import (BlockPool, RadixCache,  # noqa: F401
                      bytes_per_block)
 from .sampling import SamplingParams  # noqa: F401
 from .spec import NgramDrafter  # noqa: F401
+from .artifact import (engine_from_artifact,  # noqa: F401
+                       model_from_artifact, save_lm_artifact)
 
 __all__ = ["Engine", "Request", "sequential_generate", "Router",
            "Replica", "ReplicaServer", "ReplicaClient", "Supervisor",
            "Overloaded", "BlockPool", "RadixCache", "bytes_per_block",
-           "SamplingParams", "NgramDrafter"]
+           "SamplingParams", "NgramDrafter", "engine_from_artifact",
+           "model_from_artifact", "save_lm_artifact"]

@@ -1,0 +1,119 @@
+"""Serving cold-start from a saved inference artifact (ISSUE 15, the
+ROADMAP direction-2(b) seam).
+
+PR 8's fleet shared the model OBJECT in-process; a real deployment's
+replica boots from a checkpoint. This module closes that gap over the
+``io.save_inference_model`` artifact: the manifest's ``config`` block
+carries the decode model's hyperparameters, so a fresh process —
+holding nothing but the directory path — rebuilds the
+``TransformerLMInfer`` (via ``extract_params`` over the specialized
+Program, fused ops included) and serves token-identically to the
+source-model engine (greedy decode is deterministic; pinned in
+tests/test_specialize.py including a REAL fresh-process round trip).
+
+Surfaces:
+  save_lm_artifact(dirname, program, scope, targets, cfg...)  writer
+  model_from_artifact(dirname)      -> TransformerLMInfer
+  engine_from_artifact(dirname)     -> serving.Engine
+  serving.Engine(model=<dirname>)   the same seam inline — and since
+                                    fleet.Replica hands its ``model``
+                                    straight to Engine, a Replica cold-
+                                    starts from the directory too.
+"""
+
+import os
+
+from .. import io as _io
+from ..io import ArtifactError
+
+LM_KIND = "transformer_lm"
+
+__all__ = ["LM_KIND", "save_lm_artifact", "model_from_artifact",
+           "engine_from_artifact", "ArtifactError"]
+
+
+def save_lm_artifact(dirname, program, scope, targets, n_layer, n_head,
+                     d_model, max_len, bos_id=1, end_id=2, feeds=(),
+                     bf16=False, dtype=None, specialize=True):
+    """Write a decode-servable artifact for a ``transformer_lm``
+    training program: the specialized Program + params via
+    ``io.save_inference_model``, plus the model config the serving
+    boot needs. ``targets`` must keep the whole forward live (the
+    logits head — pruning to the loss would also work; pruning to an
+    intermediate would drop parameter ops the replayer expects).
+    ``dtype='bfloat16'`` makes the loaded engine run the PR-5 bf16
+    serving cast; ``bf16=True`` additionally stores matmul-class
+    params half-width via the transform tier's opt-in cast pass."""
+    cfg = {"kind": LM_KIND, "n_layer": int(n_layer),
+           "n_head": int(n_head), "d_model": int(d_model),
+           "max_len": int(max_len), "bos_id": int(bos_id),
+           "end_id": int(end_id)}
+    if dtype is not None:
+        cfg["dtype"] = str(dtype)
+    _io.save_inference_model(
+        dirname, list(feeds), list(targets), None,
+        main_program=program, scope=scope, specialize=specialize,
+        bf16=bf16, config=cfg)
+    return dirname
+
+
+def load_artifact_config(dirname):
+    manifest = _io.load_inference_manifest(dirname)
+    if manifest is None:
+        raise ArtifactError(
+            "%s has no artifact manifest — not a serving artifact "
+            "(legacy save_inference_model output predates the config "
+            "block serving cold-start needs)" % (dirname,))
+    return manifest, dict(manifest.get("config") or {})
+
+
+def model_from_artifact(dirname):
+    """Boot the decode model from an artifact directory: verified
+    load (CRC manifest) into a PRIVATE scope, then the parameter-
+    stream replay into a ``TransformerLMInfer``. Raises
+    ``ArtifactError`` on corruption or a config this module cannot
+    serve."""
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from ..models.transformer_infer import TransformerLMInfer
+
+    manifest, cfg = load_artifact_config(dirname)
+    kind = cfg.get("kind")
+    if kind != LM_KIND:
+        raise ArtifactError(
+            "artifact %s config kind %r is not servable by the decode "
+            "engine (want %r); ScoringEngine.from_artifact serves "
+            "dense scoring programs" % (dirname, kind, LM_KIND))
+    for key in ("n_layer", "n_head", "d_model", "max_len"):
+        if key not in cfg:
+            raise ArtifactError(
+                "artifact %s config is missing %r — cannot rebuild "
+                "the decode model" % (dirname, key))
+    scope = fluid.Scope()
+    program, _feeds, _fetches = _io.load_inference_model(
+        dirname, None, scope=scope)
+    dtype = jnp.bfloat16 if cfg.get("dtype") == "bfloat16" else None
+    try:
+        return TransformerLMInfer(
+            program, scope, int(cfg["n_layer"]), int(cfg["n_head"]),
+            int(cfg["d_model"]), int(cfg["max_len"]),
+            bos_id=int(cfg.get("bos_id", 1)),
+            end_id=int(cfg.get("end_id", 2)), dtype=dtype)
+    except AssertionError as e:
+        # the cursor's loud parameter-stream mismatch: surface it as
+        # an artifact problem, with the artifact named
+        raise ArtifactError(
+            "artifact %s parameter stream does not replay into a "
+            "%s(%s layers): %s"
+            % (dirname, LM_KIND, cfg.get("n_layer"), e)) from e
+
+
+def engine_from_artifact(dirname, **engine_kwargs):
+    """One-call serving cold-start: artifact directory -> running
+    ``serving.Engine``."""
+    from .engine import Engine
+    return Engine(model_from_artifact(dirname), **engine_kwargs)
+
+
+def is_artifact_path(model):
+    return isinstance(model, (str, os.PathLike))

@@ -78,6 +78,7 @@ spans per prefill chunk, a first-token mark, step-span links) so
 
 import collections
 import itertools
+import os
 import threading
 import time
 
@@ -269,6 +270,13 @@ class Engine:
                  spec_drafter=None, spec_layers=None):
         if slots < 1:
             raise ValueError("slots must be >= 1, got %r" % (slots,))
+        from .artifact import is_artifact_path, model_from_artifact
+        if is_artifact_path(model):
+            # serving cold-start (ISSUE 15 / ROADMAP 2(b)): a
+            # load_inference_model artifact directory in place of a
+            # live model object — fleet.Replica passes its ``model``
+            # straight here, so replicas boot from the artifact too
+            model = model_from_artifact(model)
         self.model = model
         self.slots = int(slots)
         self.name = name

@@ -176,6 +176,28 @@ class ScoringEngine:
             target=self._loop, daemon=True, name="ptpu-" + name)
         self._thread.start()
 
+    @classmethod
+    def from_artifact(cls, dirname, featurizer, fetch_name=None, **kw):
+        """Serving cold-start (ISSUE 15): boot the scoring cell from a
+        ``io.save_inference_model`` artifact directory — the verified
+        (CRC-manifested, transform-specialized) Program + params load
+        into a PRIVATE scope; the fetch defaults to the artifact's
+        first fetch target. ``featurizer`` stays a caller argument
+        (it owns the live SparseClient wiring an artifact cannot
+        capture)."""
+        import paddle_tpu as fluid
+        from ... import io as _io
+        scope = fluid.Scope()
+        program, _feeds, fetches = _io.load_inference_model(
+            dirname, None, scope=scope)
+        if fetch_name is None:
+            if not fetches:
+                raise _io.ArtifactError(
+                    "artifact %s names no fetch targets and no "
+                    "fetch_name was given" % (dirname,))
+            fetch_name = fetches[0].name
+        return cls(program, scope, fetch_name, featurizer, **kw)
+
     # -- public API --------------------------------------------------------
     def warmup(self):
         """Compile the fixed-shape scoring dispatch before traffic:

@@ -11,6 +11,17 @@ this package gives the reproduction one.
                   cse            common-subexpression elimination
                   dead_op        liveness-rooted dead-op elimination
                                  (beyond Program.prune's target walk)
+                  fusion         pattern fusion (matmul+bias+act ->
+                                 fused ops, inverse transpose/reshape
+                                 chains, scale/cast pairs)
+                  bf16_cast      OPT-IN bf16 operand cast (rtol-gated,
+                                 excluded from 'all')
+  infer         specialize_for_inference — prune + the pipeline into
+                the io.save_inference_model servable artifact
+  memory        memory_plan — compile-time liveness + greedy best-fit
+                buffer reuse (the BuddyAllocator question, static)
+  calibrate     --calibrate microbenches -> platform-stamped
+                calib.json for plan_cost (flag autoparallel_calib)
   autoparallel  enumerate valid dp/tp/pp/sp/ep DistributedStrategy
                 assignments, price them with analysis/cost.step_costs
                 + an analytic comm/bubble model calibrated against
@@ -30,7 +41,11 @@ from .passes import (  # noqa: F401
     Pass, PassManager, TransformResult, ConstantFoldPass, CSEPass,
     DeadOpEliminationPass, default_passes, passes_by_name,
     resolve_passes, maybe_transform_for_build, verify_bitwise)
+from .fusion import FusionPass, PATTERN_NAMES  # noqa: F401
+from .infer import (Bf16CastPass, SpecializeResult,  # noqa: F401
+                    specialize_for_inference)
+from .memory import MemoryPlan, memory_plan  # noqa: F401
 from .autoparallel import (  # noqa: F401
-    ModelSpec, Plan, pipeline_utilization, candidates, plan_cost,
-    plan_hbm_bytes, rank, recommend, apply, model_spec,
+    ModelSpec, Plan, pipeline_utilization, calibration, candidates,
+    plan_cost, plan_hbm_bytes, rank, recommend, apply, model_spec,
     embedding_wire_costs, recommend_embedding_placement, PLANNABLE)

@@ -60,15 +60,82 @@ def _run_pipeline(args):
                 tail = ("  bitwise-identical" if row["verified"]
                         else "  VERIFICATION FAILED: %s"
                         % row.get("detail"))
-            print("%-16s %4d -> %4d ops (-%d: %s)%s  %.1fs"
+            pats = ", ".join("%s %d" % (p, n)
+                             for p, n in row["patterns"].items() if n)
+            print("%-16s %4d -> %4d ops (-%d: %s)%s%s  %.1fs"
                   % (name, row["ops_before"], row["ops_after"],
                      row["ops_removed"],
                      ", ".join("%s %d" % (p, n)
                                for p, n in row["passes"].items()),
+                     "  [%s]" % pats if pats else "",
                      tail, row["dt_s"]))
     if args.json:
         print(json.dumps({"models": rows, "failed": failed}))
     return 1 if failed else 0
+
+
+def _run_plan_memory(args):
+    """Compile-time memory planning view (ISSUE 15): liveness + greedy
+    best-fit buffer reuse for a zoo model's program, before and after
+    the optimizing pipeline — the BuddyAllocator question answered
+    statically."""
+    from ..models import TRANSFORM_ZOO, transform_zoo_entry
+    from .memory import memory_plan
+    from .passes import PassManager, resolve_passes
+
+    name = args.plan_memory
+    if name not in TRANSFORM_ZOO:
+        print("unknown model %r; --list-models for the zoo" % name,
+              file=sys.stderr)
+        return 2
+    main, _startup, _feed_fn, fetch_names = transform_zoo_entry(name)
+    src_plan = memory_plan(main, keep=fetch_names, batch=args.batch)
+    try:
+        passes = resolve_passes(args.passes)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    out = {"model": name, "batch": args.batch,
+           "source": src_plan.to_dict()}
+    opt_plan = None
+    if passes:
+        result = PassManager(passes).run(main, keep=fetch_names)
+        opt_plan = memory_plan(result.program, keep=fetch_names,
+                               batch=args.batch)
+        out["transformed"] = opt_plan.to_dict()
+        out["transform"] = result.to_dict()
+    if args.json:
+        print(json.dumps(out))
+        return 0
+    print("== %s (batch=%d) — source program" % (name, args.batch))
+    print(src_plan.render())
+    if opt_plan is not None:
+        print("== %s — after %s" % (name,
+                                    ",".join(p.name for p in passes)))
+        print(opt_plan.render())
+        print("peak bytes source -> transformed: %d -> %d (arena), "
+              "%d -> %d (naive)"
+              % (src_plan.arena_bytes, opt_plan.arena_bytes,
+                 src_plan.naive_bytes, opt_plan.naive_bytes))
+    return 0
+
+
+def _run_calibrate(args):
+    """Measure the planner's cost-model constants on THIS backend and
+    persist the platform-stamped record (flag ``autoparallel_calib``
+    points plan_cost at it)."""
+    from .calibrate import describe, run_calibration, write_calibration
+
+    record = run_calibration()
+    write_calibration(args.out, record)
+    if args.json:
+        print(json.dumps({"path": args.out, **record}))
+    else:
+        print(describe(record, args.out))
+        print("wrote %s; set flag autoparallel_calib=%s (or "
+              "PADDLE_TPU_AUTOPARALLEL_CALIB=%s) to price plans with "
+              "it" % (args.out, args.out, args.out))
+    return 0
 
 
 def _run_plan(args):
@@ -107,12 +174,18 @@ def _run_plan(args):
         # e.g. no valid dp/tp/pp/sp/ep assignment at this device count
         print(str(e), file=sys.stderr)
         return 2
+    from .autoparallel import calibration
+    _, _, calib_src = calibration()
     if args.json:
         print(json.dumps({"model": model, "devices": devices,
+                          "calibration": calib_src,
                           "plans": [p.to_dict() for p in plans]}))
         return 0
     print("ranked plans for %s at %d devices (modeled step seconds; "
-          "calibration: PERF.md):" % (model, devices))
+          "calibration: %s):"
+          % (model, devices,
+             "PERF.md placeholders" if calib_src == "placeholder"
+             else calib_src))
     for i, p in enumerate(plans):
         b = p.breakdown
         print("%2d. %-18s cost=%.3es  compute=%.3es util=%.2f  "
@@ -146,6 +219,21 @@ def main(argv=None):
                         "for MODEL at DEVICES chips (DEVICES defaults "
                         "to the autoparallel_devices flag, else the "
                         "visible device count)")
+    p.add_argument("--plan-memory", metavar="MODEL",
+                   help="memory-planning mode: liveness + buffer-reuse "
+                        "plan (naive / planned-arena / peak-live "
+                        "bytes) for MODEL, before and after the pass "
+                        "pipeline")
+    p.add_argument("--batch", type=int, default=8,
+                   help="batch size resolving -1 dims in memory-"
+                        "planning mode (default 8)")
+    p.add_argument("--calibrate", action="store_true",
+                   help="run the matmul-FLOPs + ring-collective "
+                        "microbenches and write a platform-stamped "
+                        "calibration record for the planner's cost "
+                        "model (flag autoparallel_calib)")
+    p.add_argument("--out", default="calib.json",
+                   help="--calibrate output path (default calib.json)")
     p.add_argument("--top", type=int, default=0,
                    help="planner mode: only the best N plans")
     p.add_argument("--json", action="store_true",
@@ -170,6 +258,10 @@ def main(argv=None):
     if args.passes is None:
         from .. import flags
         args.passes = flags.get_flag("transform_passes")
+    if args.calibrate:
+        return _run_calibrate(args)
+    if args.plan_memory:
+        return _run_plan_memory(args)
     if args.plan:
         return _run_plan(args)
     return _run_pipeline(args)

@@ -37,6 +37,8 @@ API:  candidates(spec, devices)       valid strategy assignments
 CLI:  python -m paddle_tpu.transform --plan transformer 8
 """
 
+import os
+
 import numpy as np
 
 # -- calibration constants (provenance: PERF.md) ---------------------------
@@ -53,6 +55,43 @@ PEAK_FLOPS = 180e12            # per-chip peak for the compute term;
 OPTIMIZER_STATE_MULT = 3.0
 KV_BLOCK_SIZE = 16             # pool granule priced per plan (matches
                                # the serving_block_size flag default)
+
+
+_CALIB_CACHE = {}          # path -> (mtime, record)
+_CALIB_WARNED = set()
+
+
+def calibration():
+    """(peak_flops, ici_bps, source) for the cost model. The
+    ``autoparallel_calib`` flag names a ``calibrate.write_calibration``
+    record; unset / unreadable falls back to the documented
+    placeholders (a bad record warns once per path, never raises —
+    rankings are ordinal either way)."""
+    from .. import flags
+    path = flags.get_flag("autoparallel_calib") or ""
+    if not path:
+        return PEAK_FLOPS, ICI_BPS, "placeholder"
+    try:
+        mtime = os.path.getmtime(path)
+        cached = _CALIB_CACHE.get(path)
+        if cached is None or cached[0] != mtime:
+            from .calibrate import load_calibration
+            _CALIB_CACHE[path] = (mtime, load_calibration(path))
+        rec = _CALIB_CACHE[path][1]
+    except Exception as e:
+        if path not in _CALIB_WARNED:
+            _CALIB_WARNED.add(path)
+            import sys
+            print("autoparallel_calib %r unusable (%s); using "
+                  "placeholder constants" % (path, e), file=sys.stderr)
+        return PEAK_FLOPS, ICI_BPS, "placeholder"
+    peak = float(rec["peak_flops"])
+    ici = rec.get("ici_bps")
+    if ici:
+        return peak, float(ici), "measured:%s" % path
+    # single-device records carry no ring measurement: the comm terms
+    # still price at the placeholder, and the provenance must say so
+    return peak, ICI_BPS, "measured:%s (ici placeholder)" % path
 
 
 def pipeline_utilization(m, s):
@@ -185,12 +224,19 @@ def candidates(spec, devices):
 
 
 def plan_cost(spec, axes, microbatches=1,
-              peak_flops=PEAK_FLOPS, ici_bps=ICI_BPS):
+              peak_flops=None, ici_bps=None):
     """Analytic per-step cost (seconds) of one strategy assignment:
     compute spread over every chip, inflated by the pipeline bubble
     1/U(M), plus the per-axis collective traffic at ICI rate. Each
     comm term uses the standard ring-collective volume for its
-    collective (all-reduce 2(n-1)/n, all-to-all / ring pass (n-1)/n)."""
+    collective (all-reduce 2(n-1)/n, all-to-all / ring pass (n-1)/n).
+    Constants default to ``calibration()`` — a measured calib record
+    when the ``autoparallel_calib`` flag names one, the documented
+    placeholders otherwise."""
+    if peak_flops is None or ici_bps is None:
+        cal_peak, cal_ici, _ = calibration()
+        peak_flops = cal_peak if peak_flops is None else peak_flops
+        ici_bps = cal_ici if ici_bps is None else ici_bps
     dp, tp, pp, sp, ep = (axes["dp"], axes["tp"], axes["pp"],
                           axes["sp"], axes["ep"])
     n = dp * tp * pp * sp * ep
@@ -261,7 +307,7 @@ def plan_hbm_bytes(spec, axes, block_size=KV_BLOCK_SIZE,
     return params + kv, {"hbm_param_bytes": params, "hbm_kv_bytes": kv}
 
 
-def rank(spec, devices, peak_flops=PEAK_FLOPS, ici_bps=ICI_BPS,
+def rank(spec, devices, peak_flops=None, ici_bps=None,
          hbm_bytes=None):
     """All valid plans for (spec, devices), cheapest first. Ties break
     on the axes tuple so the ranking is deterministic. ``hbm_bytes``

@@ -1,0 +1,115 @@
+"""Autoparallel constant calibration (ISSUE 15 satellite, ROADMAP
+direction-4 remainder): measure the two constants the planner's cost
+model has carried as documented placeholders — per-chip matmul FLOP/s
+and ring-collective bandwidth — and write a platform-stamped
+``calib.json`` that ``plan_cost()`` loads through the
+``autoparallel_calib`` flag. With the flag unset (or the record
+unreadable) the placeholders stay in force, exactly as before: rankings
+were always ordinal; a measured record makes the modeled seconds
+absolute for THIS platform.
+
+CLI: ``python -m paddle_tpu.transform --calibrate [--out calib.json]``.
+A CPU-container record is committed as ``CALIB_r01.json`` (rankings
+unchanged — same constants for every candidate); the owed chip round
+re-runs it so plan costs become real seconds.
+"""
+
+import json
+import os
+import time
+
+__all__ = ["run_calibration", "write_calibration", "load_calibration"]
+
+SCHEMA = 1
+
+
+def _time_best(fn, repeats):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_calibration(matmul_n=1024, ring_elems=1 << 20, repeats=5):
+    """Measure matmul FLOP/s and (multi-device only) ring all-reduce
+    bandwidth on the current backend. Returns the calib record dict."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    n = int(matmul_n)
+    a = jnp.ones((n, n), jnp.float32)
+    b = jnp.ones((n, n), jnp.float32)
+    mm = jax.jit(lambda x, y: x @ y)
+    mm(a, b).block_until_ready()          # compile outside the clock
+    best = _time_best(lambda: mm(a, b).block_until_ready(), repeats)
+    measured_flops = 2.0 * n ** 3 / best
+
+    devices = jax.device_count()
+    ici_bps = None
+    ring_note = "single device: ring collective not measurable"
+    if devices >= 2:
+        elems = int(ring_elems)
+        xs = jnp.ones((devices, elems), jnp.float32)
+        ar = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")
+        ar(xs).block_until_ready()
+        t = _time_best(lambda: ar(xs).block_until_ready(), repeats)
+        # ring all-reduce moves 2(d-1)/d of the buffer per link
+        vol = 2.0 * (devices - 1) / devices * elems * 4
+        ici_bps = vol / t
+        ring_note = ("ring all-reduce over %d %s device(s)"
+                     % (devices, dev.platform))
+
+    return {
+        "schema": SCHEMA,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "devices": devices,
+        "matmul_n": n,
+        "matmul_best_s": best,
+        "peak_flops": measured_flops,
+        "ici_bps": ici_bps,
+        "ring_note": ring_note,
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+    }
+
+
+def write_calibration(path, record=None):
+    """Run (if needed) and atomically persist a calib record."""
+    from ..io import write_json_atomic
+    record = record if record is not None else run_calibration()
+    write_json_atomic(path, record)
+    return record
+
+
+def load_calibration(path):
+    """Read + validate one calib record; raises ValueError on a file
+    that is not a calibration record (the planner falls back to
+    placeholders on any failure, loudly)."""
+    with open(path) as f:
+        rec = json.load(f)
+    if not isinstance(rec, dict) or "peak_flops" not in rec:
+        raise ValueError("%s is not a calibration record "
+                         "(no peak_flops stamp)" % (path,))
+    if not (isinstance(rec["peak_flops"], (int, float))
+            and rec["peak_flops"] > 0):
+        raise ValueError("%s: peak_flops must be a positive number"
+                         % (path,))
+    ici = rec.get("ici_bps")
+    if ici is not None and not (isinstance(ici, (int, float))
+                                and ici > 0):
+        raise ValueError("%s: ici_bps must be positive or null"
+                         % (path,))
+    return rec
+
+
+def describe(record, path="?"):
+    ici = record.get("ici_bps")
+    return ("calibration %s [%s/%s, %d dev]: peak %.3e FLOP/s, ici %s"
+            % (os.path.basename(str(path)), record.get("platform"),
+               record.get("device_kind") or "-",
+               record.get("devices", 0), record["peak_flops"],
+               ("%.3e B/s" % ici) if ici else "placeholder"))

@@ -1,0 +1,213 @@
+"""Compile-time memory planning (ISSUE 15): the survey's
+BuddyAllocator capability recast as a liveness pass over the Program
+IR.
+
+The reference managed activation memory at runtime (a buddy allocator
+grabbing and splitting chunks per op as the interpreter walked the
+block). In a jit world the whole block compiles to ONE XLA program, so
+the equivalent lever is static: compute every intermediate's live range
+over the (topologically ordered — append order IS execution order)
+global block, then pack non-overlapping ranges into one arena with
+greedy best-fit offset assignment. The resulting plan answers the
+question the runtime allocator answered — "how much memory does this
+program actually need?" — before anything executes:
+
+  naive_bytes      every intermediate gets its own buffer (no reuse —
+                   what a never-freeing allocator would hold)
+  peak_live_bytes  max over time of simultaneously-live bytes (the
+                   reuse lower bound no allocator can beat)
+  arena_bytes      the greedy best-fit plan's arena size (achieved
+                   reuse; >= peak_live_bytes, usually equal or close)
+  param_bytes      persistable state, reported separately (it lives in
+                   the Scope arena for the program's whole life)
+
+Sizes come from the same static accounting the analysis cost model
+prices bytes with: ``shape x dtype`` via the runtime dtype table
+(64-bit narrowing included). ``-1`` batch dims resolve against the
+``batch`` argument.
+
+Surface: ``transform.memory_plan(program)`` and the
+``python -m paddle_tpu.transform --plan-memory MODEL`` CLI view.
+"""
+
+import numpy as np
+
+from ..core.program import runtime_dtype
+from .passes import op_inputs
+
+__all__ = ["Buffer", "MemoryPlan", "memory_plan"]
+
+
+class Buffer:
+    """One planned intermediate: [start, end] op-index live range and
+    the arena offset the greedy packer assigned."""
+
+    __slots__ = ("name", "nbytes", "start", "end", "offset")
+
+    def __init__(self, name, nbytes, start, end):
+        self.name = name
+        self.nbytes = int(nbytes)
+        self.start = start
+        self.end = end
+        self.offset = None
+
+    def overlaps(self, other):
+        return not (self.end < other.start or other.end < self.start)
+
+    def to_dict(self):
+        return {"name": self.name, "nbytes": self.nbytes,
+                "start": self.start, "end": self.end,
+                "offset": self.offset}
+
+
+class MemoryPlan:
+    def __init__(self, buffers, naive_bytes, peak_live_bytes,
+                 arena_bytes, param_bytes, unsized):
+        self.buffers = buffers              # list[Buffer], offset set
+        self.naive_bytes = naive_bytes
+        self.peak_live_bytes = peak_live_bytes
+        self.arena_bytes = arena_bytes
+        self.param_bytes = param_bytes
+        self.unsized = unsized              # names we could not size
+
+    @property
+    def reuse_ratio(self):
+        if not self.arena_bytes:
+            return 1.0
+        return self.naive_bytes / float(self.arena_bytes)
+
+    def to_dict(self):
+        return {"naive_bytes": self.naive_bytes,
+                "peak_live_bytes": self.peak_live_bytes,
+                "arena_bytes": self.arena_bytes,
+                "param_bytes": self.param_bytes,
+                "reuse_ratio": round(self.reuse_ratio, 3),
+                "buffers": [b.to_dict() for b in self.buffers],
+                "unsized": list(self.unsized)}
+
+    def render(self, top=12):
+        lines = [
+            "memory plan: %d intermediate buffer(s)" % len(self.buffers),
+            "  no-reuse (naive): %12s" % _fmt(self.naive_bytes),
+            "  planned arena:    %12s  (%.2fx reuse)"
+            % (_fmt(self.arena_bytes), self.reuse_ratio),
+            "  peak-live bound:  %12s" % _fmt(self.peak_live_bytes),
+            "  persistables:     %12s  (scope arena, unplanned)"
+            % _fmt(self.param_bytes),
+        ]
+        if self.unsized:
+            lines.append("  unsized (dynamic shape, excluded): %s"
+                         % ", ".join(sorted(self.unsized)[:8]))
+        biggest = sorted(self.buffers, key=lambda b: -b.nbytes)[:top]
+        if biggest:
+            lines.append("  largest buffers (offset @ live range):")
+            for b in biggest:
+                lines.append("    %-28s %10s  @%-10d ops [%d, %d]"
+                             % (b.name[:28], _fmt(b.nbytes), b.offset,
+                                b.start, b.end))
+        return "\n".join(lines)
+
+
+def _fmt(b):
+    for unit, scale in (("GiB", 2 ** 30), ("MiB", 2 ** 20),
+                        ("KiB", 2 ** 10)):
+        if b >= scale:
+            return "%.2f %s" % (b / scale, unit)
+    return "%d B" % b
+
+
+def _var_nbytes(v, batch):
+    if v is None or v.shape is None:
+        return None
+    n = 1
+    for s in v.shape:
+        s = int(s)
+        if s < 0:
+            s = batch
+        n *= max(1, s)
+    return n * np.dtype(runtime_dtype(v.dtype)).itemsize
+
+
+def memory_plan(program, keep=(), batch=1):
+    """Liveness + buffer-reuse plan for ``program``'s global block.
+
+    ``keep`` names stay live to the end of the block (fetch targets);
+    ``batch`` resolves ``-1`` leading dims. Persistables are excluded
+    from the plan (they are the Scope's permanent arena) and summed
+    into ``param_bytes``; vars without a static shape are listed in
+    ``unsized`` rather than silently mispriced."""
+    gb = program.global_block()
+    ops = gb.ops
+    keep = {str(k) for k in keep}
+    persistable = {n for n, v in gb.vars.items() if v.persistable}
+
+    first_def, last_use = {}, {}
+    for t, op in enumerate(ops):
+        for n in op_inputs(op):
+            last_use[n] = t
+        for n in op.output_names:
+            first_def.setdefault(n, t)
+            last_use[n] = t
+    end_t = len(ops)
+    for n in keep:
+        last_use[n] = end_t
+
+    param_bytes = 0
+    for n in persistable:
+        nb = _var_nbytes(gb.vars.get(n), batch)
+        if nb:
+            param_bytes += nb
+
+    buffers, unsized = [], []
+    for n, t0 in first_def.items():
+        if n in persistable:
+            continue
+        nb = _var_nbytes(gb.vars.get(n), batch)
+        if nb is None:
+            if gb.vars.get(n) is not None:
+                unsized.append(n)
+            continue
+        buffers.append(Buffer(n, nb, t0, last_use.get(n, t0)))
+    # feeds (is_data vars actually read) are live from block entry
+    for n, v in gb.vars.items():
+        if v.is_data and not v.persistable and n in last_use \
+                and n not in first_def:
+            nb = _var_nbytes(v, batch)
+            if nb is not None:
+                buffers.append(Buffer(n, nb, -1, last_use[n]))
+
+    naive = sum(b.nbytes for b in buffers)
+
+    # exact peak-live lower bound: sweep op boundaries
+    events = []
+    for b in buffers:
+        events.append((b.start, b.nbytes))
+        events.append((b.end + 1, -b.nbytes))
+    events.sort()
+    live = peak = 0
+    for _, delta in events:
+        live += delta
+        peak = max(peak, live)
+
+    # greedy best-fit: place big buffers first; each takes the
+    # smallest gap (among range-overlapping neighbours) that fits
+    arena = 0
+    for b in sorted(buffers, key=lambda x: (-x.nbytes, x.start,
+                                            x.name)):
+        neighbours = sorted(
+            ((o.offset, o.offset + o.nbytes) for o in buffers
+             if o.offset is not None and o.overlaps(b)),
+            key=lambda iv: iv[0])
+        best_off, best_gap = None, None
+        cursor = 0
+        for lo, hi in neighbours:
+            gap = lo - cursor
+            if gap >= b.nbytes and (best_gap is None or gap < best_gap):
+                best_off, best_gap = cursor, gap
+            cursor = max(cursor, hi)
+        b.offset = cursor if best_off is None else best_off
+        arena = max(arena, b.offset + b.nbytes)
+
+    buffers.sort(key=lambda b: (b.start, b.name))
+    return MemoryPlan(buffers, naive, peak, arena, param_bytes,
+                      unsized)

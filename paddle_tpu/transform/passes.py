@@ -349,12 +349,23 @@ class ConstantFoldPass(Pass):
 
 def default_passes():
     """The shipped pipeline, in application order: fold constants so
-    duplicate results unify, dedup, then drop what fell dead."""
-    return [ConstantFoldPass(), CSEPass(), DeadOpEliminationPass()]
+    duplicate results unify, dedup, drop what fell dead, then fuse the
+    surviving chains (fusion last: dedup first means one fused op per
+    unique chain, and fusion output never grows the CSE search
+    space)."""
+    from .fusion import FusionPass
+    return [ConstantFoldPass(), CSEPass(), DeadOpEliminationPass(),
+            FusionPass()]
 
 
 def passes_by_name():
-    return {p.name: p for p in default_passes()}
+    """Name -> instance for every selectable pass: the default pipeline
+    plus the opt-in passes 'all' deliberately excludes (bf16_cast is
+    rtol-gated, not bitwise — see transform/infer.py)."""
+    from .infer import Bf16CastPass
+    table = {p.name: p for p in default_passes()}
+    table["bf16_cast"] = Bf16CastPass()
+    return table
 
 
 def resolve_passes(spec):
@@ -383,14 +394,17 @@ def resolve_passes(spec):
 class TransformResult:
     """PassManager output: the transformed clone + per-pass accounting
     (``stats[pass_name]`` = ops removed or rewritten by that pass),
-    plus the op counts before/after for the one-line story."""
+    per-PATTERN fusion hits (``patterns``), plus the op counts
+    before/after for the one-line story."""
 
-    def __init__(self, program, stats, ops_before, ops_after, rounds):
+    def __init__(self, program, stats, ops_before, ops_after, rounds,
+                 patterns=None):
         self.program = program
         self.stats = stats            # OrderedDict pass -> changes
         self.ops_before = ops_before
         self.ops_after = ops_after
         self.rounds = rounds
+        self.patterns = dict(patterns or {})   # pattern -> hits
 
     @property
     def ops_removed(self):
@@ -401,7 +415,8 @@ class TransformResult:
                 "ops_after": self.ops_after,
                 "ops_removed": self.ops_removed,
                 "rounds": self.rounds,
-                "passes": dict(self.stats)}
+                "passes": dict(self.stats),
+                "patterns": dict(self.patterns)}
 
 
 class PassManager:
@@ -422,6 +437,7 @@ class PassManager:
         clone = program.clone()
         keep = tuple(str(k) for k in keep)
         stats = collections.OrderedDict((p.name, 0) for p in self.passes)
+        patterns = collections.OrderedDict()
         ops_before = len(clone.global_block().ops)
         rounds = 0
         for _ in range(self.max_rounds):
@@ -435,8 +451,12 @@ class PassManager:
                 after = len(clone.global_block().ops)
                 stats[p.name] += n
                 changed += n
+                hits = getattr(p, "last_patterns", None)
+                if hits:
+                    for pat, c in hits.items():
+                        patterns[pat] = patterns.get(pat, 0) + c
                 _mon.on_transform(clone, p.name, before, after, dt,
-                                  changes=n)
+                                  changes=n, patterns=hits)
             if not changed:
                 break
         ops_after = len(clone.global_block().ops)
@@ -445,10 +465,11 @@ class PassManager:
             "parent_version": program._version,
             "version": clone._version,
             "passes": dict(stats),
+            "patterns": {k: v for k, v in patterns.items() if v},
             "ops_removed": ops_before - ops_after,
         }
         return TransformResult(clone, stats, ops_before, ops_after,
-                               rounds)
+                               rounds, patterns=patterns)
 
 
 def maybe_transform_for_build(program, fetch_names):

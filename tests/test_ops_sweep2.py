@@ -868,6 +868,11 @@ COVERED_ELSEWHERE = {
     "split_ids": "test_distributed.py",
     "send_sparse": "test_dist_lookup_table.py",
     "ssd_loss": "test_ssd.py",
+    # fused ops (ISSUE 15) — only ever emitted by transform/fusion.py;
+    # their lowerings delegate to the component ops covered above, and
+    # the fusion tier pins golden rewrites + bitwise execution identity
+    "fused_matmul_bias_act": "test_specialize.py",
+    "fused_scale_cast": "test_specialize.py",
 }
 
 # ops with no one-op test by design; each entry documents why

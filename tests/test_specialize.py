@@ -1,0 +1,744 @@
+"""ISSUE 15: compiler tier v2 — pattern fusion, inference
+specialization (`save_inference_model` artifact round-trip), and
+compile-time memory planning.
+
+Contracts pinned here:
+  * per-pattern golden fixtures: exact before/after op lists for every
+    fusion pattern, plus the safety rules (multi-consumer
+    intermediates, protected outputs) that keep the non-SSA IR honest;
+  * fusion execution identity: the cnn_infer zoo model — the one that
+    exercises ALL pattern families — re-executes bitwise (the full-zoo
+    sweep rides tests/test_transform.py's slow tier, which now runs
+    fusion via default_passes);
+  * specialize_for_inference: training machinery stripped, chains
+    fused, forward bitwise vs the source program; the opt-in bf16 pass
+    is rtol-gated (NOT bitwise) with the f32-stats contract visible in
+    the rewritten IR;
+  * the artifact: save -> load (fresh scope AND a REAL fresh process)
+    -> serve BITWISE/token-identical to the source engine, and every
+    corruption mode raises the typed ArtifactError instead of serving
+    garbage;
+  * serving cold-start: Engine(model=<dir>), fleet Replica routed
+    decode identity, ScoringEngine.from_artifact;
+  * memory planning: hand-computed naive/peak/arena golden;
+  * autoparallel calibration: measured record loads through the
+    autoparallel_calib flag, bad records fall back to placeholders.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, serving
+from paddle_tpu.io import ArtifactError
+from paddle_tpu.models import transform_zoo_entry, transformer
+from paddle_tpu.models.transformer_infer import TransformerLMInfer
+from paddle_tpu.transform import (
+    Bf16CastPass, FusionPass, PassManager, default_passes, memory_plan,
+    plan_cost, specialize_for_inference, verify_bitwise)
+from paddle_tpu.transform.autoparallel import ModelSpec
+
+N_LAYER, N_HEAD, D_MODEL, MAX_LEN, VOCAB = 1, 2, 32, 48, 40
+
+
+def _ops(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def _staged(build):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    return main, startup, fetches
+
+
+# -- per-pattern golden fixtures (build + rewrite only; no compiles) -------
+
+def test_fusion_golden_matmul_bias_act():
+    def build():
+        x = fluid.layers.data("x", [8])
+        h = fluid.layers.fc(x, 4, act="relu")     # mul+add+relu -> 1
+        return fluid.layers.fc(h, 2)              # mul+add       -> 1
+
+    main, _, out = _staged(build)
+    assert _ops(main) == ["mul", "elementwise_add", "relu",
+                          "mul", "elementwise_add"]
+    res = PassManager([FusionPass()]).run(main, keep=[out.name])
+    assert _ops(res.program) == ["fused_matmul_bias_act"] * 2
+    assert res.patterns["matmul_bias_act"] == 1
+    assert res.patterns["matmul_bias"] == 1
+    assert res.stats["fusion"] == 3               # 5 ops -> 2
+    first, second = res.program.global_block().ops
+    assert first.attr("mm_type") == "mul"
+    assert first.attr("act_type") == "relu"
+    assert second.attr("act_type") == ""
+    # the fused op writes the chain's ORIGINAL final name
+    assert second.output("Out") == [out.name]
+
+
+def test_fusion_golden_transpose_pairs():
+    def build():
+        x = fluid.layers.data("x", [2, 3, 4])
+        a = fluid.layers.transpose(x, [0, 2, 3, 1])
+        b = fluid.layers.transpose(a, [0, 3, 1, 2])   # inverse: gone
+        c = fluid.layers.scale(b, 2.0)
+        d = fluid.layers.transpose(c, [0, 2, 1, 3])
+        e = fluid.layers.transpose(d, [0, 1, 3, 2])   # composes
+        return fluid.layers.scale(e, 3.0)
+
+    main, _, out = _staged(build)
+    res = PassManager([FusionPass()]).run(main, keep=[out.name])
+    got = _ops(res.program)
+    # the inverse pair vanished outright (consumers renamed); the
+    # non-inverse pair composed into ONE transpose
+    assert got == ["scale", "transpose", "scale"]
+    assert res.patterns["transpose_transpose"] == 2
+    tr = res.program.global_block().ops[1]
+    assert tr.attr("axis") == [0, 2, 3, 1]
+    # the scale reads the ORIGINAL input name after the rename
+    sc = res.program.global_block().ops[0]
+    assert sc.input("X") == ["x"]
+
+
+def test_fusion_golden_transpose_identity_protected_keeps_assign():
+    """An inverse pair whose OUTPUT is a fetch target cannot be
+    renamed away — the name must hold a value — so the pair collapses
+    to a passthrough assign instead."""
+    def build():
+        x = fluid.layers.data("x", [2, 3])
+        a = fluid.layers.transpose(x, [0, 2, 1])
+        return fluid.layers.transpose(a, [0, 2, 1])
+
+    main, _, out = _staged(build)
+    res = PassManager([FusionPass()]).run(main, keep=[out.name])
+    assert _ops(res.program) == ["assign"]
+    a = res.program.global_block().ops[0]
+    assert a.input("X") == ["x"] and a.output("Out") == [out.name]
+
+
+def test_fusion_golden_reshape_chain_and_scale_cast():
+    def build():
+        x = fluid.layers.data("x", [2, 6])
+        r = fluid.layers.reshape(x, [-1, 12])
+        r2 = fluid.layers.reshape(r, [-1, 3, 4])      # outer wins
+        c = fluid.layers.cast(r2, "float32")
+        return fluid.layers.scale(c, 0.5, bias=1.0)   # pairs with cast
+
+    main, _, out = _staged(build)
+    assert _ops(main) == ["reshape", "reshape", "cast", "scale"]
+    res = PassManager([FusionPass()]).run(main, keep=[out.name])
+    assert _ops(res.program) == ["reshape", "fused_scale_cast"]
+    assert res.patterns["reshape_reshape"] == 1
+    assert res.patterns["scale_cast"] == 1
+    rs = res.program.global_block().ops[0]
+    assert rs.attr("shape") == [-1, 3, 4] and rs.input("X") == ["x"]
+    fsc = res.program.global_block().ops[1]
+    assert [t for t, _ in fsc.attr("ops")] == ["cast", "scale"]
+
+
+def test_fusion_safety_rules():
+    """A multi-consumer intermediate, a keep-set intermediate and an
+    RNG-adjacent chain all refuse to fuse."""
+    def build():
+        x = fluid.layers.data("x", [4])
+        mm = fluid.layers.fc(x, 4, bias_attr=False)    # bare mul
+        y = fluid.layers.elementwise_add(mm, mm)       # reads it twice
+        return y, mm
+
+    main, _, (y, mm) = _staged(build)
+    res = PassManager([FusionPass()]).run(main, keep=[y.name])
+    assert _ops(res.program) == _ops(main)             # no match
+    assert sum(res.patterns.values()) == 0
+
+    # keep-set protection: fusing would erase a fetched intermediate
+    def build2():
+        x = fluid.layers.data("x", [4])
+        h = fluid.layers.fc(x, 4, act="relu")
+        return h
+
+    main2, _, h = _staged(build2)
+    gb = main2.global_block()
+    pre_act = gb.ops[1].output("Out")[0]               # the add's out
+    res2 = PassManager([FusionPass()]).run(main2,
+                                           keep=[h.name, pre_act])
+    # mul+add may still fuse (their output IS the kept name), but the
+    # activation must stay separate — the kept pre-activation value
+    # must exist
+    kept = _ops(res2.program)
+    assert kept[-1] == gb.ops[-1].type                 # act survives
+    assert pre_act in [n for op in res2.program.global_block().ops
+                       for n in op.output_names]
+
+
+def test_fusion_pattern_counters_tick():
+    from paddle_tpu.monitor import runtime as monrt
+
+    def build():
+        x = fluid.layers.data("x", [8])
+        return fluid.layers.fc(x, 4, act="relu")
+
+    main, _, out = _staged(build)
+    before = monrt.TRANSFORM_PATTERNS.value(pattern="matmul_bias_act")
+    PassManager([FusionPass()]).run(main, keep=[out.name])
+    after = monrt.TRANSFORM_PATTERNS.value(pattern="matmul_bias_act")
+    assert after == before + 1
+
+
+def test_cnn_infer_zoo_fuses_all_patterns_bitwise():
+    """The composed-inference zoo model exercises EVERY pattern family
+    and re-executes bitwise — the tier-1 representative of the
+    full-zoo slow sweep."""
+    main, startup, feed_fn, fetch_names = transform_zoo_entry(
+        "cnn_infer")
+    res = PassManager(default_passes()).run(main, keep=fetch_names)
+    assert _ops(res.program) == [
+        "fused_scale_cast", "fused_matmul_bias_act", "pool2d",
+        "reshape", "fused_matmul_bias_act"]
+    for pat in ("matmul_bias_act", "transpose_transpose",
+                "reshape_reshape", "scale_cast"):
+        assert res.patterns[pat] >= 1, res.patterns
+    ok, detail = verify_bitwise(main, startup, feed_fn, fetch_names,
+                                res.program)
+    assert ok, detail
+
+
+# -- specialize_for_inference ----------------------------------------------
+
+def _train_net():
+    x = fluid.layers.data("x", [8])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    h = fluid.layers.fc(x, 6, act="relu")
+    pred = fluid.layers.fc(h, 3, act="softmax")
+    cost = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return pred, cost
+
+
+def test_specialize_strips_training_and_stays_bitwise():
+    main, startup, (pred, cost) = _staged(_train_net)
+    assert "sgd" in _ops(main) and "backward_marker" in _ops(main)
+    spec = specialize_for_inference(main, ["x"], [pred.name])
+    got = _ops(spec.program)
+    assert got == ["fused_matmul_bias_act", "fused_matmul_bias_act"]
+    assert spec.transform.patterns["matmul_bias_act"] == 2
+    # the source program was never mutated
+    assert "sgd" in _ops(main)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).rand(4, 8)
+                .astype(np.float32)}
+        # forward-only reference (running the FULL main would apply
+        # the sgd update and move the weights under the comparison)
+        src = exe.run(main.prune([pred.name]), feed=feed,
+                      fetch_list=[pred.name])[0]
+        got = exe.run(spec.program, feed=feed,
+                      fetch_list=[pred.name])[0]
+    assert np.asarray(got).tobytes() == np.asarray(src).tobytes()
+
+
+def test_specialize_validates_names():
+    main, _, (pred, _) = _staged(_train_net)
+    with pytest.raises(ValueError, match="not a variable"):
+        specialize_for_inference(main, ["bogus"], [pred.name])
+
+
+def test_bf16_pass_rtol_contract_not_bitwise():
+    """The opt-in bf16 cast: matmul-class operands round to bf16,
+    every output casts straight back to f32 (stats contract), weights
+    flip to bf16 storage — outputs move (NOT bitwise) but stay inside
+    the pinned rtol envelope. Off by default: bf16=False emits no
+    casts."""
+    main, startup, (pred, cost) = _staged(_train_net)
+    plain = specialize_for_inference(main, ["x"], [pred.name])
+    assert "cast" not in _ops(plain.program)          # off by default
+
+    spec = specialize_for_inference(main, ["x"], [pred.name],
+                                    bf16=True)
+    ops = spec.program.global_block().ops
+    assert spec.bf16_sites == 2
+    # every fused matmul's output feeds a cast BACK to f32 — the
+    # f32-stats contract in IR form
+    for i, op in enumerate(ops):
+        if op.type == "fused_matmul_bias_act":
+            nxt = ops[i + 1]
+            assert nxt.type == "cast" \
+                and nxt.attr("out_dtype") == "float32"
+    gb = spec.program.global_block()
+    w = [v for n, v in gb.vars.items() if n.endswith(".w_0")]
+    assert w and all(v.dtype == "bfloat16" for v in w)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(1).rand(4, 8)
+                .astype(np.float32)}
+        src = np.asarray(exe.run(main.prune([pred.name]), feed=feed,
+                                 fetch_list=[pred.name])[0])
+        got = np.asarray(exe.run(spec.program, feed=feed,
+                                 fetch_list=[pred.name])[0])
+    assert got.tobytes() != src.tobytes()             # it DID round
+    np.testing.assert_allclose(got, src, rtol=2e-2, atol=2e-2)
+
+
+# -- the artifact round trip ------------------------------------------------
+
+@pytest.fixture()
+def small_artifact(tmp_path):
+    main, startup, (pred, cost) = _staged(_train_net)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = str(tmp_path / "art")
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main, scope=scope)
+    return {"dir": d, "main": main, "scope": scope, "pred": pred}
+
+
+def test_artifact_roundtrip_bitwise_and_manifest(small_artifact):
+    s = small_artifact
+    feed = {"x": np.random.RandomState(2).rand(4, 8)
+            .astype(np.float32)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(s["scope"]):
+        src = np.asarray(exe.run(
+            s["main"].prune([s["pred"].name]), feed=feed,
+            fetch_list=[s["pred"].name]))
+
+    m = fluid.io.load_inference_manifest(s["dir"])
+    assert m["format"] == 2
+    assert m["feed_names"] == ["x"]
+    assert m["fetch_names"] == [s["pred"].name]
+    assert m["transform"]["patterns"]["matmul_bias_act"] == 2
+    assert isinstance(m["model_crc32"], int)
+    assert isinstance(m["params_crc32"], int)
+
+    fresh = fluid.Scope()
+    with fluid.scope_guard(fresh):
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            s["dir"], exe)
+        assert feeds == ["x"]
+        assert [v.name for v in fetches] == [s["pred"].name]
+        assert _ops(prog) == ["fused_matmul_bias_act"] * 2
+        got = np.asarray(exe.run(prog, feed=feed,
+                                 fetch_list=fetches))
+    assert got.tobytes() == src.tobytes()
+
+
+def test_artifact_corrupt_matrix(small_artifact):
+    """Every corruption mode raises the TYPED ArtifactError naming the
+    damaged piece — a serving replica must never boot garbage
+    weights."""
+    d = small_artifact["dir"]
+    m = fluid.io.load_inference_manifest(d)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def reload():
+        with fluid.scope_guard(fluid.Scope()):
+            return fluid.io.load_inference_model(d, exe)
+
+    pf = os.path.join(d, m["params_file"])
+    blob = open(pf, "rb").read()
+
+    # truncated params
+    open(pf, "wb").write(blob[:-16])
+    with pytest.raises(ArtifactError, match="params CORRUPT"):
+        reload()
+    # bit-flipped params
+    flipped = bytearray(blob)
+    flipped[len(flipped) // 2] ^= 0x40
+    open(pf, "wb").write(bytes(flipped))
+    with pytest.raises(ArtifactError, match="params CORRUPT"):
+        reload()
+    open(pf, "wb").write(blob)
+    reload()                                   # restored: loads again
+
+    # missing model file
+    mf = os.path.join(d, m["model_file"])
+    model_bytes = open(mf, "rb").read()
+    os.unlink(mf)
+    with pytest.raises(ArtifactError, match="program missing"):
+        reload()
+    # bit-flipped program
+    open(mf, "wb").write(model_bytes[:-4] + b"xxxx")
+    with pytest.raises(ArtifactError, match="program CORRUPT"):
+        reload()
+    open(mf, "wb").write(model_bytes)
+
+    # torn manifest
+    man = os.path.join(d, fluid.io.MANIFEST)
+    man_bytes = open(man, "rb").read()
+    open(man, "wb").write(man_bytes[:-8])
+    with pytest.raises(ArtifactError, match="manifest"):
+        reload()
+    open(man, "wb").write(man_bytes)
+    reload()
+
+    # and a non-servable artifact: serving boot needs the config block
+    with pytest.raises(ArtifactError, match="not a serving artifact"):
+        serving.model_from_artifact(str(d) + "-nope")  # no manifest
+
+
+def test_artifact_legacy_dir_still_loads(tmp_path):
+    """Pre-manifest directories (the original save format) load
+    through the unchanged legacy path."""
+    main, startup, (pred, cost) = _staged(_train_net)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "legacy")
+    os.makedirs(d)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        prog = fluid.io.get_inference_program([pred], main)
+        dd = prog.to_dict()
+        dd["feed_names"], dd["fetch_names"] = ["x"], [pred.name]
+        with open(os.path.join(d, "__model__"), "w") as f:
+            json.dump(dd, f)
+        fluid.io.save_persistables(exe, d, prog, scope=scope)
+    fresh = fluid.Scope()
+    with fluid.scope_guard(fresh):
+        prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    assert feeds == ["x"] and [v.name for v in fetches] == [pred.name]
+    assert fresh.find_var("fc_0.w_0") is not None
+
+
+def test_bf16_artifact_stores_half_width_params(tmp_path):
+    main, startup, (pred, cost) = _staged(_train_net)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = str(tmp_path / "bf16art")
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main, scope=scope,
+                                      bf16=True)
+    m = fluid.io.load_inference_manifest(d)
+    assert m["bf16"] is True
+    assert m["param_dtypes"].get("fc_0.w_0") == "bfloat16"
+    fresh = fluid.Scope()
+    with fluid.scope_guard(fresh):
+        fluid.io.load_inference_model(d, exe)
+    import ml_dtypes
+    arr = np.asarray(fresh.find_var("fc_0.w_0"))
+    assert arr.dtype == np.dtype(ml_dtypes.bfloat16)
+    src = np.asarray(scope.find_var("fc_0.w_0"))
+    np.testing.assert_array_equal(
+        arr.astype(np.float32),
+        src.astype(np.dtype(ml_dtypes.bfloat16)).astype(np.float32))
+
+
+# -- serving cold-start -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm_setup(tmp_path_factory):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        avg_cost, logits = transformer.transformer_lm(
+            vocab_size=VOCAB, max_len=MAX_LEN, n_layer=N_LAYER,
+            n_head=N_HEAD, d_model=D_MODEL, d_inner=64)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        lm = TransformerLMInfer(main, scope, N_LAYER, N_HEAD, D_MODEL,
+                                MAX_LEN)
+    art = str(tmp_path_factory.mktemp("lm") / "artifact")
+    serving.save_lm_artifact(art, main, scope, [logits], N_LAYER,
+                             N_HEAD, D_MODEL, MAX_LEN)
+    return {"lm": lm, "art": art}
+
+
+def _requests(rng, n, max_prompt=8, min_new=4, max_new=10):
+    reqs = []
+    for _ in range(n):
+        plen = int(rng.randint(1, max_prompt + 1))
+        prompt = [1] + rng.randint(3, VOCAB, plen - 1).tolist()
+        reqs.append((prompt, int(rng.randint(min_new, max_new + 1))))
+    return reqs
+
+
+def test_engine_from_artifact_token_identity(lm_setup):
+    """The ISSUE acceptance core, in process: an engine booted from
+    the artifact DIRECTORY serves token-identically (scores bitwise)
+    to the source-model engine. The artifact's fused program replays
+    the identical parameter stream (extract_params learned fused
+    ops)."""
+    m = fluid.io.load_inference_manifest(lm_setup["art"])
+    assert m["config"]["kind"] == "transformer_lm"
+    assert sum(m["transform"]["patterns"].values()) >= 1
+    reqs = _requests(np.random.RandomState(7), 6)
+    e1 = serving.Engine(lm_setup["lm"], slots=2, prefill_chunk=4,
+                        name="src")
+    e2 = serving.Engine(lm_setup["art"], slots=2, prefill_chunk=4,
+                        name="art")
+    try:
+        o1 = e1.generate_many([p for p, _ in reqs], 8)
+        o2 = e2.generate_many([p for p, _ in reqs], 8)
+    finally:
+        e1.close()
+        e2.close()
+    for i, ((t1, s1), (t2, s2)) in enumerate(zip(o1, o2)):
+        assert t1 == t2, "request %d diverged" % i
+        assert float(s1) == float(s2)
+
+
+def test_fresh_process_artifact_serve_bitwise(lm_setup, tmp_path):
+    """THE acceptance criterion: a FRESH PROCESS holding nothing but
+    the artifact directory serves the same tokens/scores as the
+    source-model engine here."""
+    reqs = _requests(np.random.RandomState(11), 4, max_new=8)
+    e1 = serving.Engine(lm_setup["lm"], slots=2, prefill_chunk=4,
+                        name="src2")
+    try:
+        want = e1.generate_many([p for p, _ in reqs], 6)
+    finally:
+        e1.close()
+
+    script = tmp_path / "serve_artifact.py"
+    script.write_text(
+        "import json, sys\n"
+        "from paddle_tpu import serving\n"
+        "eng = serving.engine_from_artifact(sys.argv[1], slots=2,\n"
+        "                                   prefill_chunk=4)\n"
+        "outs = eng.generate_many(json.loads(sys.argv[2]),\n"
+        "                         int(sys.argv[3]))\n"
+        "eng.close()\n"
+        "print('ARTOUT ' + json.dumps([[t, float(s)]\n"
+        "                              for t, s in outs]))\n")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (root, os.environ.get("PYTHONPATH"))
+                   if p))
+    proc = subprocess.run(
+        [sys.executable, str(script), lm_setup["art"],
+         json.dumps([p for p, _ in reqs]), "6"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("ARTOUT ")][-1]
+    got = json.loads(line[len("ARTOUT "):])
+    assert len(got) == len(want)
+    for (wt, ws), (gt, gs) in zip(want, got):
+        assert list(wt) == list(gt)
+        assert float(ws) == float(gs)
+
+
+def test_replica_cold_start_routed_identity(lm_setup, tmp_path):
+    """Fleet seam (ROADMAP direction 2(b)): a Replica handed the
+    artifact DIRECTORY boots its engine from disk; routed decode is
+    token-identical to the source-model engine."""
+    from paddle_tpu.distributed.membership import KVClient, KVServer
+    from paddle_tpu.serving import fleet
+    from paddle_tpu.serving.fleet import Router
+
+    reqs = _requests(np.random.RandomState(13), 5, max_new=8)
+    e1 = serving.Engine(lm_setup["lm"], slots=2, prefill_chunk=4,
+                        name="src3")
+    try:
+        want = e1.generate_many([p for p, _ in reqs], 6)
+    finally:
+        e1.close()
+
+    kvs = KVServer(sweep_interval=0.05).start()
+    kv = KVClient(kvs.endpoint)
+    cell = router = None
+    try:
+        cell = fleet.Replica(kv, lm_setup["art"], desired=1, slots=2,
+                             prefill_chunk=4, ttl=0.5)
+        router = Router(kvs.endpoint, window=4, max_queue=64,
+                        refresh_interval=0.05, name="router-art")
+        router.wait_for_replicas(1, timeout=15)
+        got = router.generate_many([p for p, _ in reqs],
+                                   [6] * len(reqs), timeout=120)
+        for (wt, ws), (gt, gs) in zip(want, got):
+            assert list(wt) == list(gt)
+    finally:
+        if router is not None:
+            router.close()
+        if cell is not None:
+            cell.shutdown()
+        try:
+            kv.shutdown_server()
+            kv.close()
+        except OSError:
+            pass
+
+
+def test_scoring_engine_from_artifact_bitwise(tmp_path):
+    """The dense-scoring cold-start twin: ScoringEngine.from_artifact
+    scores bitwise vs a direct run of the source program."""
+    from paddle_tpu.models import deepfm as dfm
+    from paddle_tpu.serving.sparse.scoring import ScoringEngine
+
+    F, DIM = 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        prob, _ = dfm.build_scoring_net(F, DIM, dnn_dims=(8,))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / "scoring-art")
+        fluid.io.save_inference_model(
+            d, ["fm_first_rows", "fm_second_rows"], [prob], exe,
+            main_program=main, scope=scope)
+
+    rng = np.random.RandomState(3)
+    feats = [{"first": rng.rand(F).astype(np.float32),
+              "second": rng.rand(F, DIM).astype(np.float32)}
+             for _ in range(3)]
+
+    def featurizer(features, batch):
+        first = np.zeros((batch, F), np.float32)
+        second = np.zeros((batch, F, DIM), np.float32)
+        for i, f in enumerate(features):
+            first[i], second[i] = f["first"], f["second"]
+        first.setflags(write=False)
+        second.setflags(write=False)
+        return {"fm_first_rows": first, "fm_second_rows": second}
+
+    eng = ScoringEngine.from_artifact(d, featurizer, batch=2,
+                                      name="art-scoring")
+    try:
+        got = eng.score_many(feats)
+    finally:
+        eng.close()
+    with fluid.scope_guard(scope):
+        for i, f in enumerate(feats):
+            out, = exe.run(main, feed=featurizer([f], 2),
+                           fetch_list=[prob.name])
+            want = float(np.asarray(out).reshape(-1)[0])
+            assert got[i] == want, (i, got[i], want)
+
+
+# -- memory planning --------------------------------------------------------
+
+def test_memory_plan_golden_hand_computed():
+    """x(feed) -> a -> b -> add(a,b)=c, batch 2, f32 [., 4] = 32 B
+    each. Hand-computed: naive 128 B; at the add, a+b+c live = 96 B
+    peak; greedy packs b into x's slot -> 96 B arena."""
+    def build():
+        x = fluid.layers.data("x", [4])
+        a = fluid.layers.scale(x, 2.0)
+        b = fluid.layers.scale(a, 3.0)
+        return fluid.layers.elementwise_add(a, b)
+
+    main, _, c = _staged(build)
+    plan = memory_plan(main, keep=[c.name], batch=2)
+    assert plan.naive_bytes == 128
+    assert plan.peak_live_bytes == 96
+    assert plan.arena_bytes == 96
+    assert plan.param_bytes == 0
+    by_name = {b.name: b for b in plan.buffers}
+    assert by_name["x"].start == -1 and by_name["x"].end == 0
+    assert by_name[c.name].end == len(main.global_block().ops)
+    # no two live-overlapping buffers share bytes
+    bufs = plan.buffers
+    for i, b1 in enumerate(bufs):
+        for b2 in bufs[i + 1:]:
+            if b1.overlaps(b2):
+                assert (b1.offset + b1.nbytes <= b2.offset
+                        or b2.offset + b2.nbytes <= b1.offset), \
+                    (b1.to_dict(), b2.to_dict())
+    assert "planned arena" in plan.render()
+
+
+def test_memory_plan_shrinks_after_fusion():
+    """Fusion erases intermediates, so the planned arena of the
+    transformed program never exceeds the source's (cnn_infer: the
+    transpose/reshape copies disappear outright)."""
+    main, _, _, fetch_names = transform_zoo_entry("cnn_infer")
+    src = memory_plan(main, keep=fetch_names, batch=4)
+    res = PassManager(default_passes()).run(main, keep=fetch_names)
+    opt = memory_plan(res.program, keep=fetch_names, batch=4)
+    assert opt.naive_bytes < src.naive_bytes
+    assert opt.arena_bytes <= src.arena_bytes
+    assert src.reuse_ratio >= 1.0 and opt.reuse_ratio >= 1.0
+
+
+# -- autoparallel calibration ----------------------------------------------
+
+def test_calibration_record_drives_plan_cost(tmp_path):
+    from paddle_tpu import flags
+    from paddle_tpu.transform import autoparallel as ap
+    from paddle_tpu.transform.calibrate import (load_calibration,
+                                                write_calibration)
+
+    spec = ModelSpec("toy", flops=1e12, bytes=1e9, param_bytes=4e8,
+                     batch=8, seq=128, d_model=256, n_layer=4,
+                     n_head=8)
+    axes = {"dp": 2, "tp": 1, "pp": 1, "sp": 1, "ep": 1}
+    path = str(tmp_path / "calib.json")
+    write_calibration(path, {
+        "schema": 1, "platform": "cpu", "devices": 8,
+        "peak_flops": 2e12, "ici_bps": 5e10})
+    rec = load_calibration(path)
+    assert rec["peak_flops"] == 2e12
+
+    baseline = plan_cost(spec, axes)[0]
+    flags.set_flag("autoparallel_calib", path)
+    try:
+        measured = plan_cost(spec, axes)[0]
+        explicit = plan_cost(spec, axes, peak_flops=2e12,
+                             ici_bps=5e10)[0]
+        assert measured == explicit != baseline
+        peak, ici, source = ap.calibration()
+        assert (peak, ici) == (2e12, 5e10)
+        assert source.startswith("measured:")
+
+        # a bad record falls back to placeholders, loudly but safely
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            f.write("{not json")
+        flags.set_flag("autoparallel_calib", bad)
+        assert plan_cost(spec, axes)[0] == baseline
+    finally:
+        flags.set_flag("autoparallel_calib", None)
+
+    with pytest.raises(ValueError, match="peak_flops"):
+        write_calibration(str(tmp_path / "bad2.json"),
+                          {"peak_flops": -1})
+        load_calibration(str(tmp_path / "bad2.json"))
+
+
+def test_committed_cpu_calibration_record_loads():
+    """The CPU-container record this PR commits (the chip round
+    re-runs --calibrate and replaces it) is a valid, platform-stamped
+    record."""
+    from paddle_tpu.transform.calibrate import load_calibration
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rec = load_calibration(os.path.join(root, "CALIB_r01.json"))
+    assert rec["platform"] == "cpu"
+    assert rec["peak_flops"] > 0
+
+
+# -- CLI surfaces -----------------------------------------------------------
+
+def test_cli_plan_memory_and_pattern_json(capsys):
+    from paddle_tpu.transform.__main__ import main as tmain
+
+    assert tmain(["--plan-memory", "cnn_infer", "--json",
+                  "--batch", "2"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["transformed"]["arena_bytes"] <= \
+        out["source"]["arena_bytes"]
+    assert out["transform"]["patterns"]["scale_cast"] == 1
+
+    # satellite: the pipeline --json emits machine-readable per-pass
+    # pattern counts
+    assert tmain(["cnn_infer", "--no-verify", "--json"]) == 0
+    out2 = json.loads(capsys.readouterr().out)
+    pats = out2["models"][0]["patterns"]
+    assert pats["matmul_bias_act"] >= 1
+    assert pats["transpose_transpose"] == 1
+
+    assert tmain(["--plan-memory", "nope"]) == 2

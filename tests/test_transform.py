@@ -201,10 +201,14 @@ def test_dead_op_beyond_prune_keeps_training_semantics():
 
 def test_resolve_passes_grammar():
     assert [p.name for p in resolve_passes("all")] == \
-        ["constant_fold", "cse", "dead_op"]
+        ["constant_fold", "cse", "dead_op", "fusion"]
     assert resolve_passes("none") == []
     assert [p.name for p in resolve_passes("cse,dead_op")] == \
         ["cse", "dead_op"]
+    # the opt-in (rtol-gated, non-bitwise) bf16 pass is selectable by
+    # NAME but deliberately excluded from 'all'
+    assert [p.name for p in resolve_passes("fusion,bf16_cast")] == \
+        ["fusion", "bf16_cast"]
     with pytest.raises(ValueError):
         resolve_passes("cse,bogus")
 
