@@ -4,6 +4,14 @@ Runs the static analyzer over zoo models and exits non-zero when any
 diagnostic reaches --fail-on severity (default: error) — the CI gate
 that keeps the model zoo honest without TPU time. Run under
 JAX_PLATFORMS=cpu; tracing never touches a device.
+
+``--runtime`` switches to the runtime-code lint
+(paddle_tpu.analysis.runtime): AST rules over the package sources —
+lock discipline, RPC verb conformance, metric/flag catalog
+consistency, thread-shared-state heuristic — gated by the checked-in
+waiver file. Exit codes match the zoo path: 0 clean (or fully
+waived), 1 findings at/above --fail-on, 2 usage error (including a
+malformed waiver file).
 """
 
 import argparse
@@ -52,6 +60,8 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.master",
     "paddle_tpu.distributed.membership",
+    "paddle_tpu.analysis.runtime",
+    "paddle_tpu.analysis.runtime.rules",
 )
 
 
@@ -91,7 +101,20 @@ def main(argv=None):
                         "output")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--list-models", action="store_true")
+    p.add_argument("--runtime", action="store_true",
+                   help="run the runtime-code lint (locks, RPC verbs, "
+                        "metric/flag catalog, shared state) instead "
+                        "of the jaxpr zoo analyzer")
+    p.add_argument("--root",
+                   help="repository root to lint (--runtime only; "
+                        "default: this checkout)")
+    p.add_argument("--waivers",
+                   help="waiver file for --runtime ('none' disables; "
+                        "default: analysis/runtime/waivers.json)")
     args = p.parse_args(argv)
+
+    if args.runtime:
+        return _runtime_main(p, args)
 
     from . import registered_rules, zoo_names
     from .zoo import analyze_zoo
@@ -137,6 +160,39 @@ def main(argv=None):
         print(report.to_json())
     else:
         print(report.render_text(verbose=args.verbose))
+    return 1 if report.at_least(args.fail_on) else 0
+
+
+def _runtime_main(p, args):
+    from .runtime import (run_runtime, registered_runtime_rules,
+                          WaiverError)
+
+    if args.list_rules:
+        for name, cls in sorted(registered_runtime_rules().items(),
+                                key=lambda kv: kv[1].id):
+            print("%-6s %-20s %s" % (cls.id, name, cls.doc))
+        return 0
+    rules = None
+    if args.rules:
+        table = registered_runtime_rules()
+        names = args.rules.split(",")
+        bad = set(names) - set(table)
+        if bad:
+            p.error("unknown runtime rule(s) %s; --runtime "
+                    "--list-rules for the catalog"
+                    % ", ".join(sorted(bad)))
+        rules = [table[n]() for n in names]
+    try:
+        report = run_runtime(root=args.root, rules=rules,
+                             waivers_path=(args.waivers
+                                           if args.waivers is not None
+                                           else ""))
+    except WaiverError as e:
+        p.error(str(e))                   # argparse exits 2
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render_text())
     return 1 if report.at_least(args.fail_on) else 0
 
 

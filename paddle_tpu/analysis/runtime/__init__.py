@@ -1,0 +1,39 @@
+"""paddle_tpu.analysis.runtime — concurrency, protocol & catalog lint.
+
+The runtime-code counterpart of the jaxpr analyzer: PR 1's rules lint
+the *graph*, but the serving stack's dominant bug class lives in the
+*runtime* code around it — locks held across socket I/O, lock-order
+inversions, RPC verb tables drifting out of sync with the fault/retry
+classification, and metric/flag catalogs drifting from the docs. This
+subpackage walks the whole codebase with stdlib ``ast`` (no execution,
+no new deps) and turns those hand-found review classes into exit-code
+gates:
+
+  RT01 lock-discipline      per-class lock-acquisition graph: cycles
+                            (potential deadlock) + blocking calls
+                            (socket send/recv/connect, sleeps, thread
+                            joins, retry-policy runs) under a held lock
+  RT02 verb-conformance     every RPC dispatch verb must be covered by
+                            resilience.faults._DEFAULT_OPS, classified
+                            in resilience.retry.VERB_CLASSES, and
+                            served by a trace-header-aware loop
+  RT03 catalog-consistency  every ptpu_* metric referenced anywhere in
+                            the package or the README catalog must be
+                            registered exactly once with one kind;
+                            every flag read must be registered
+  RT04 thread-shared-state  attributes of thread-spawning classes
+                            mutated from >=2 methods with no lock in
+                            scope (INFO heuristic)
+
+API:   run_runtime(root=None) -> RuntimeReport
+CLI:   python -m paddle_tpu.analysis --runtime [--json]
+       (CI gate: exit 0 only when every finding at/above --fail-on is
+       covered by a justified waiver in analysis/runtime/waivers.json)
+"""
+
+from .astscan import SourceIndex, SourceFile  # noqa: F401
+from .engine import (  # noqa: F401
+    Finding, RuntimeReport, RuntimeRule, register_runtime_rule,
+    registered_runtime_rules, default_runtime_rules, run_rules,
+    run_runtime, load_waivers, WaiverError, default_waivers_path)
+from . import rules  # noqa: F401  (register the built-in rules)

@@ -1,0 +1,187 @@
+"""One-pass AST index over the package sources.
+
+Every runtime rule consumes a ``SourceIndex``: each ``*.py`` file under
+``paddle_tpu/`` parsed ONCE with stdlib ``ast`` (never imported, never
+executed — linting must not spin up jax, sockets, or threads), plus the
+raw text of non-Python catalog inputs (README.md). Rules therefore see
+the same tree and share the parse cost; the whole index builds in well
+under a second, which is what keeps the ``--runtime`` gate inside the
+tier-1 seconds budget.
+
+``SourceIndex.from_sources`` builds the same structure from in-memory
+``{relpath: text}`` mappings so the golden-fixture tests can lint tiny
+synthetic modules through the exact production rule path.
+"""
+
+import ast
+import os
+
+__all__ = ["SourceFile", "SourceIndex", "dotted_name", "literal_str",
+           "class_methods", "iter_lock_scopes", "repo_root"]
+
+
+def repo_root():
+    """The repository root (the directory holding ``paddle_tpu/``)."""
+    here = os.path.abspath(os.path.dirname(__file__))   # .../analysis/runtime
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def dotted_name(node):
+    """``a.b.c`` for a Name/Attribute chain (incl. ``self.x``), else
+    None for anything non-trivial (subscripts, calls, literals)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def literal_str(node):
+    """The value of a string-literal node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def class_methods(cls):
+    """{name: FunctionDef} for a ClassDef's direct (a)sync methods."""
+    out = {}
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[stmt.name] = stmt
+    return out
+
+
+def iter_lock_scopes(stmts, lock_of, held=()):
+    """Walk a statement list tracking which locks are held, yielding
+    ``(kind, node, held, lock)`` tuples:
+
+      ("acquire", with_item_expr, held_before, lock)  entering a
+          ``with <lock>:`` item recognised by ``lock_of(expr)``;
+      ("node", ast_node, held, None)  every other expression-level
+          node, with the tuple of locks held at that point (innermost
+          last).
+
+    Nested function/class definitions are separate scopes and are NOT
+    descended into. ``lock_of`` maps a with-item context expression to
+    a canonical lock name, or None for non-lock context managers."""
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            cur = list(held)
+            for item in s.items:
+                lk = lock_of(item.context_expr)
+                if lk is not None:
+                    yield ("acquire", item.context_expr, tuple(cur), lk)
+                    cur.append(lk)
+                else:
+                    for sub in ast.walk(item.context_expr):
+                        yield ("node", sub, tuple(cur), None)
+            for t in iter_lock_scopes(s.body, lock_of, tuple(cur)):
+                yield t
+        elif isinstance(s, ast.Try):
+            for part in (s.body, s.orelse, s.finalbody):
+                for t in iter_lock_scopes(part, lock_of, held):
+                    yield t
+            for h in s.handlers:
+                for t in iter_lock_scopes(h.body, lock_of, held):
+                    yield t
+        elif isinstance(s, (ast.If, ast.While)):
+            for sub in ast.walk(s.test):
+                yield ("node", sub, held, None)
+            for part in (s.body, s.orelse):
+                for t in iter_lock_scopes(part, lock_of, held):
+                    yield t
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(s.iter):
+                yield ("node", sub, held, None)
+            for part in (s.body, s.orelse):
+                for t in iter_lock_scopes(part, lock_of, held):
+                    yield t
+        else:
+            for sub in ast.walk(s):
+                yield ("node", sub, held, None)
+
+
+class SourceFile:
+    """One parsed Python source: repo-relative path + text + tree."""
+
+    __slots__ = ("path", "text", "lines", "tree")
+
+    def __init__(self, path, text):
+        self.path = path.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=self.path)
+
+    def classes(self):
+        """Top-level ClassDef nodes."""
+        return [n for n in self.tree.body if isinstance(n, ast.ClassDef)]
+
+    def functions(self):
+        """Top-level (a)sync FunctionDef nodes."""
+        return [n for n in self.tree.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+class SourceIndex:
+    """All parsed sources + raw catalog texts, keyed by relative path."""
+
+    def __init__(self, files, texts=None, root=None):
+        self.files = dict(files)          # relpath -> SourceFile
+        self.texts = dict(texts or {})    # relpath -> raw text (README)
+        self.root = root                  # filesystem root, when real
+
+    @classmethod
+    def from_root(cls, root=None):
+        """Index every ``paddle_tpu/**/*.py`` under ``root`` (default:
+        this repository) plus README.md when present. Unparseable files
+        raise — a syntax error in the tree IS a finding-worthy state,
+        but it belongs to the interpreter, not a lint waiver."""
+        root = os.path.abspath(root or repo_root())
+        files = {}
+        pkg = os.path.join(root, "paddle_tpu")
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full, "r", encoding="utf-8") as f:
+                    files[rel] = SourceFile(rel, f.read())
+        texts = {}
+        readme = os.path.join(root, "README.md")
+        if os.path.exists(readme):
+            with open(readme, "r", encoding="utf-8") as f:
+                texts["README.md"] = f.read()
+        return cls(files, texts, root=root)
+
+    @classmethod
+    def from_sources(cls, sources, texts=None):
+        """Index in-memory ``{relpath: python_text}`` (fixture path)."""
+        return cls({p: SourceFile(p, t) for p, t in sources.items()},
+                   texts=texts, root=None)
+
+    def find(self, suffix):
+        """The SourceFile whose path ends with ``suffix`` (deterministic:
+        shortest, then lexicographic, on ties), or None."""
+        hits = sorted((p for p in self.files if p.endswith(suffix)),
+                      key=lambda p: (len(p), p))
+        return self.files[hits[0]] if hits else None
+
+    def iter_files(self):
+        for path in sorted(self.files):
+            yield self.files[path]
+
+    def iter_classes(self):
+        """(SourceFile, ClassDef) over every top-level class."""
+        for sf in self.iter_files():
+            for cls_node in sf.classes():
+                yield sf, cls_node

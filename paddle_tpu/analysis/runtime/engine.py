@@ -1,0 +1,275 @@
+"""Rule engine for the runtime lint (mirrors analysis.engine/diagnostics).
+
+Same shape as the jaxpr tier so both CLIs feel identical: registered
+rule classes, per-rule capped+deduped findings, severities ERROR >
+WARNING > INFO, text and ``--json`` renderings, exit 1 when anything at
+or above ``--fail-on`` survives.
+
+The one new mechanism is the WAIVER file: deliberate findings at HEAD
+(e.g. KVClient serializing its socket under the client lock BY DESIGN)
+are checked in to ``analysis/runtime/waivers.json`` with a one-line
+justification each, keyed by exact ``(rule, file, line)``. Waivers are
+themselves linted loudly:
+
+  * an entry whose anchor no longer exists (file gone, line out of
+    range) is STALE -> ERROR finding (the code moved; re-justify);
+  * an entry matching no current finding is UNMATCHED -> ERROR finding
+    (the defect was fixed; delete the waiver);
+  * a malformed/unreadable waiver file is a usage error -> exit 2.
+
+So the gate can never silently rot: waivers pin findings the way golden
+tests pin behavior.
+"""
+
+import json
+
+from ..diagnostics import ERROR, WARNING, INFO, severity_rank
+from .astscan import SourceIndex
+
+import os
+
+__all__ = ["Finding", "RuntimeReport", "RuntimeRule",
+           "register_runtime_rule", "registered_runtime_rules",
+           "default_runtime_rules", "run_rules", "run_runtime",
+           "load_waivers", "WaiverError", "default_waivers_path"]
+
+_SEVERITIES = (ERROR, WARNING, INFO)
+
+
+def default_waivers_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "waivers.json")
+
+
+class Finding:
+    """One runtime-lint finding, anchored to ``file:line``."""
+
+    __slots__ = ("rule", "severity", "file", "line", "message", "where",
+                 "hint", "waived")
+
+    def __init__(self, rule, severity, file, line, message, where=None,
+                 hint=None):
+        assert severity in _SEVERITIES, severity
+        self.rule = rule
+        self.severity = severity
+        self.file = file
+        self.line = int(line)
+        self.message = message
+        self.where = where          # qualname context, e.g. Class.method
+        self.hint = hint
+        self.waived = None          # justification string once waived
+
+    @property
+    def anchor(self):
+        return (self.rule, self.file, self.line)
+
+    def to_dict(self):
+        d = {"rule": self.rule, "severity": self.severity,
+             "file": self.file, "line": self.line,
+             "message": self.message}
+        if self.where:
+            d["where"] = self.where
+        if self.hint:
+            d["hint"] = self.hint
+        if self.waived is not None:
+            d["waived"] = self.waived
+        return d
+
+    def render(self):
+        loc = "%s:%d" % (self.file, self.line)
+        head = "[%s] %s %s: %s" % (self.severity, self.rule, loc,
+                                   self.message)
+        if self.where:
+            head += "  (in %s)" % self.where
+        if self.waived is not None:
+            head += "  [waived: %s]" % self.waived
+        out = [head]
+        if self.hint:
+            out.append("    hint: %s" % self.hint)
+        return "\n".join(out)
+
+
+class RuntimeRule:
+    """Base class: subclass, set ``name``/``id``/``doc``, implement
+    ``check(index)`` yielding Findings. ``run`` dedups identical
+    (anchor, message) findings and caps at ``max_reports`` keeping the
+    most severe first — same contract as analysis.engine.Rule."""
+
+    name = "abstract"
+    id = "RT00"
+    doc = ""
+    max_reports = 50
+
+    def check(self, index):
+        raise NotImplementedError
+
+    def run(self, index):
+        seen = set()
+        out = []
+        for f in self.check(index):
+            key = f.anchor + (f.message,)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(f)
+        out.sort(key=lambda f: (-severity_rank(f.severity), f.file,
+                                f.line, f.message))
+        return out[: self.max_reports]
+
+
+_RULES = {}
+
+
+def register_runtime_rule(cls):
+    _RULES[cls.name] = cls
+    return cls
+
+
+def registered_runtime_rules():
+    return dict(_RULES)
+
+
+def default_runtime_rules():
+    return [_RULES[name]() for name in sorted(_RULES)]
+
+
+class WaiverError(Exception):
+    """Malformed waiver file (usage error: CLI exits 2)."""
+
+
+def load_waivers(path):
+    """Parse the waiver file. Returns a list of dicts with rule/file/
+    line/reason. Raises WaiverError on any malformed entry — a waiver
+    without a justification is not a waiver."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError as e:
+        raise WaiverError("cannot read waiver file %s: %s" % (path, e))
+    except ValueError as e:
+        raise WaiverError("invalid JSON in %s: %s" % (path, e))
+    entries = data.get("waivers") if isinstance(data, dict) else None
+    if not isinstance(entries, list):
+        raise WaiverError('%s: expected {"waivers": [...]}' % path)
+    out = []
+    for i, ent in enumerate(entries):
+        if not isinstance(ent, dict):
+            raise WaiverError("%s: waiver #%d is not an object"
+                              % (path, i))
+        missing = [k for k in ("rule", "file", "line", "reason")
+                   if k not in ent]
+        if missing:
+            raise WaiverError("%s: waiver #%d missing %s"
+                              % (path, i, ",".join(missing)))
+        if not str(ent["reason"]).strip():
+            raise WaiverError("%s: waiver #%d has an empty reason"
+                              % (path, i))
+        out.append({"rule": str(ent["rule"]), "file": str(ent["file"]),
+                    "line": int(ent["line"]),
+                    "reason": str(ent["reason"])})
+    return out
+
+
+class RuntimeReport:
+    """Findings from one run, split into live vs waived."""
+
+    def __init__(self, findings, waived=(), root=None):
+        self.findings = list(findings)    # live (gate these)
+        self.waived = list(waived)        # matched by a waiver entry
+        self.root = root
+
+    def counts(self):
+        c = {s: 0 for s in _SEVERITIES}
+        for f in self.findings:
+            c[f.severity] += 1
+        return c
+
+    def at_least(self, severity):
+        floor = severity_rank(severity)
+        return [f for f in self.findings
+                if severity_rank(f.severity) >= floor]
+
+    def render_text(self):
+        out = []
+        for f in self.findings:
+            out.append(f.render())
+        for f in self.waived:
+            out.append(f.render())
+        c = self.counts()
+        out.append("runtime lint: %d error(s), %d warning(s), %d "
+                   "info(s), %d waived"
+                   % (c[ERROR], c[WARNING], c[INFO], len(self.waived)))
+        return "\n".join(out)
+
+    def to_json(self):
+        return json.dumps(
+            {"counts": self.counts(),
+             "findings": [f.to_dict() for f in self.findings],
+             "waived": [f.to_dict() for f in self.waived]},
+            indent=2, sort_keys=True)
+
+
+def _apply_waivers(findings, waivers, index):
+    """Split findings into (live, waived); append loud findings for
+    stale or unmatched waiver entries."""
+    live, waived = [], []
+    by_anchor = {}
+    for f in findings:
+        by_anchor.setdefault(f.anchor, []).append(f)
+    matched = set()
+    for ent in waivers:
+        anchor = (ent["rule"], ent["file"], ent["line"])
+        sf = index.files.get(ent["file"])
+        if sf is None or not (1 <= ent["line"] <= len(sf.lines)):
+            live.append(Finding(
+                "waivers", ERROR, ent["file"], ent["line"],
+                "stale waiver for rule '%s': anchor does not exist"
+                % ent["rule"],
+                hint="the code moved; re-anchor or delete the entry"))
+            continue
+        if anchor in by_anchor:
+            matched.add(anchor)
+        else:
+            live.append(Finding(
+                "waivers", ERROR, ent["file"], ent["line"],
+                "unmatched waiver for rule '%s': no current finding "
+                "at this anchor" % ent["rule"],
+                hint="the finding was fixed; delete the waiver entry"))
+    reasons = {(e["rule"], e["file"], e["line"]): e["reason"]
+               for e in waivers}
+    for f in findings:
+        if f.anchor in matched:
+            f.waived = reasons[f.anchor]
+            waived.append(f)
+        else:
+            live.append(f)
+    return live, waived
+
+
+def run_rules(index, rules=None, waivers=None):
+    """Run ``rules`` (default: all registered) over a SourceIndex and
+    apply ``waivers`` (a parsed entry list, or None)."""
+    rules = list(rules) if rules is not None else default_runtime_rules()
+    findings = []
+    for rule in rules:
+        findings.extend(rule.run(index))
+    live, waived = _apply_waivers(findings, waivers or [], index)
+    live.sort(key=lambda f: (-severity_rank(f.severity), f.file,
+                             f.line, f.rule, f.message))
+    waived.sort(key=lambda f: (f.file, f.line, f.rule))
+    return RuntimeReport(live, waived, root=index.root)
+
+
+def run_runtime(root=None, rules=None, waivers_path=""):
+    """Whole-repo entry point: index the package at ``root``, run every
+    rule, apply the checked-in waiver file. ``waivers_path``: "" means
+    the default file (missing -> no waivers), None/'none' disables."""
+    index = SourceIndex.from_root(root)
+    entries = []
+    if waivers_path == "":
+        path = default_waivers_path()
+        if os.path.exists(path):
+            entries = load_waivers(path)
+    elif waivers_path not in (None, "none"):
+        entries = load_waivers(waivers_path)
+    return run_rules(index, rules=rules, waivers=entries)

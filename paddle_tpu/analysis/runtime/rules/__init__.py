@@ -1,0 +1,12 @@
+"""Built-in runtime-lint rules (importing a module registers its rule).
+
+RT01 locks.py         lock-order cycles + blocking calls under a lock
+RT02 verbs.py         RPC dispatch verbs vs fault/retry tables + trace
+RT03 catalog.py       ptpu_* metric & flag catalog consistency
+RT04 shared_state.py  unlocked shared-attribute mutation heuristic
+"""
+
+from . import locks       # noqa: F401
+from . import verbs       # noqa: F401
+from . import catalog     # noqa: F401
+from . import shared_state  # noqa: F401

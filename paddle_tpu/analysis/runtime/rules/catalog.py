@@ -1,0 +1,226 @@
+"""RT03 catalog-consistency: ptpu_* metrics and flags vs their tables.
+
+Metrics: a REGISTRATION is a ``.counter("ptpu_x", ...)`` /
+``.gauge(...)`` / ``.histogram(...)`` call with a literal name; a
+REFERENCE is any whole string literal matching ``ptpu_[a-z0-9_]+``
+anywhere in the package (watch/slo/fleet_lines read metrics back by
+name) or any name in the README catalog (brace groups expand:
+``ptpu_fleet_{a,b}_total`` documents two metrics; a trailing
+``{label}`` group is stripped; Prometheus ``_bucket``/``_sum``/
+``_count`` suffixes resolve to their histogram). Checks:
+
+  * reference to a never-registered name       -> ERROR
+  * one name registered with two kinds         -> ERROR (kind mismatch)
+  * one name registered at two sites           -> WARNING (duplicate)
+  * README documents an unregistered name      -> ERROR (ghost metric)
+  * registered name absent from the README     -> WARNING (catalog
+    drift — regenerate the catalog section)
+
+Flags: every ``get_flag("x")`` / ``set_flag("x")`` / ``_flag("x")``
+literal read must name a flag registered in ``flags.py``'s
+``_register`` table (ERROR), and a registered flag with no literal
+read anywhere is an INFO (env-only flags are legitimate, but the
+inventory should be conscious). Dynamic (non-literal) reads are
+invisible to the lint and intentionally out of scope.
+"""
+
+import ast
+import re
+
+from ..astscan import dotted_name, literal_str
+from ..engine import (Finding, RuntimeRule, register_runtime_rule,
+                      ERROR, WARNING, INFO)
+
+__all__ = ["CatalogConsistencyRule"]
+
+_METRIC_RE = re.compile(r"^ptpu_[a-z0-9_]*[a-z0-9]$")
+_README_RE = re.compile(
+    r"ptpu_[a-z0-9_]*"                    # base (may end at a brace)
+    r"(?:\{[a-z0-9_,]+\}[a-z0-9_]*)?")    # one brace group + tail
+_KINDS = ("counter", "gauge", "histogram")
+_PROM_SUFFIXES = ("_bucket", "_sum", "_count")
+_FLAG_READS = ("get_flag", "set_flag", "_flag")
+
+# paths whose literals are not part of the runtime catalog (this lint's
+# own sources and docs mention metric names as examples)
+_SELF = "analysis/runtime"
+
+
+def _skip(sf):
+    return _SELF in sf.path
+
+
+def _expand_readme_token(tok):
+    """['ptpu_a_total', ...] for one README token. A brace group after
+    a trailing underscore brace-expands the name
+    (``ptpu_fleet_{shed,queue_depth}`` documents two metrics); a group
+    right after a complete name is a label annotation and is stripped
+    (``ptpu_alert_transitions_total{rule,severity,state}``). A bare
+    token ending in '_' is a prefix mention in prose, not a name."""
+    if "{" not in tok:
+        return [tok] if _METRIC_RE.match(tok) else []
+    head, rest = tok.split("{", 1)
+    group, tail = rest.split("}", 1)
+    parts = group.split(",")
+    if head.endswith("_"):
+        return [n for n in (head + p + tail for p in parts)
+                if _METRIC_RE.match(n)]
+    return [head + tail] if _METRIC_RE.match(head + tail) else []
+
+
+class CatalogConsistencyRule(RuntimeRule):
+    name = "catalog-consistency"
+    id = "RT03"
+    doc = ("every ptpu_* metric referenced in code or the README "
+           "catalog registered exactly once with one kind; every "
+           "flag read registered")
+    max_reports = 80
+
+    def check(self, index):
+        for f in self._check_metrics(index):
+            yield f
+        for f in self._check_flags(index):
+            yield f
+
+    # -- metrics -----------------------------------------------------------
+    def _check_metrics(self, index):
+        regs = {}       # name -> [(kind, file, line)]
+        reg_sites = set()
+        for sf in index.iter_files():
+            if _skip(sf):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name = dotted_name(node.func)
+                tail = name.split(".")[-1] if name else None
+                if tail not in _KINDS:
+                    continue
+                metric = literal_str(node.args[0])
+                if metric is None or not metric.startswith("ptpu_"):
+                    continue
+                regs.setdefault(metric, []).append(
+                    (tail, sf.path, node.args[0].lineno))
+                reg_sites.add((sf.path, node.args[0].lineno, metric))
+        # kind mismatches + duplicates
+        for metric in sorted(regs):
+            sites = regs[metric]
+            kinds = sorted({k for k, _, _ in sites})
+            if len(kinds) > 1:
+                _, path, line = sites[1]
+                yield Finding(
+                    self.name, ERROR, path, line,
+                    "metric '%s' registered with mismatched kinds: %s"
+                    % (metric, "/".join(kinds)),
+                    hint="first registration: %s:%d as %s"
+                         % (sites[0][1], sites[0][2], sites[0][0]))
+            elif len(sites) > 1:
+                _, path, line = sites[1]
+                yield Finding(
+                    self.name, WARNING, path, line,
+                    "metric '%s' registered %d times (first: %s:%d)"
+                    % (metric, len(sites), sites[0][1], sites[0][2]),
+                    hint="register once at module scope and share it")
+
+        def registered(name):
+            if name in regs:
+                return True
+            for suf in _PROM_SUFFIXES:
+                if name.endswith(suf) and name[: -len(suf)] in regs:
+                    return True
+            return False
+
+        # code references
+        for sf in index.iter_files():
+            if _skip(sf):
+                continue
+            for node in ast.walk(sf.tree):
+                metric = literal_str(node)
+                if metric is None or not _METRIC_RE.match(metric):
+                    continue
+                if (sf.path, node.lineno, metric) in reg_sites:
+                    continue
+                if not registered(metric):
+                    yield Finding(
+                        self.name, ERROR, sf.path, node.lineno,
+                        "metric '%s' referenced but never registered"
+                        % metric,
+                        hint="register it (monitor registry) or fix "
+                             "the name")
+        # README catalog
+        documented = set()
+        for path, text in sorted(index.texts.items()):
+            for lineno, line in enumerate(text.splitlines(), 1):
+                for m in _README_RE.finditer(line):
+                    end = m.end()
+                    if end < len(line) and line[end] in "*<":
+                        continue        # wildcard/placeholder in prose
+                    for name in _expand_readme_token(m.group(0)):
+                        documented.add(name)
+                        for suf in _PROM_SUFFIXES:
+                            if name.endswith(suf):
+                                documented.add(name[: -len(suf)])
+                        if not registered(name):
+                            yield Finding(
+                                self.name, ERROR, path, lineno,
+                                "README documents metric '%s' which "
+                                "is not registered" % name,
+                                hint="ghost catalog entry — fix the "
+                                     "name or register the metric")
+        if index.texts:
+            for metric in sorted(regs):
+                if metric not in documented:
+                    _, path, line = regs[metric][0]
+                    yield Finding(
+                        self.name, WARNING, path, line,
+                        "metric '%s' is registered but absent from "
+                        "the README catalog" % metric,
+                        hint="add it to the README metrics section")
+
+    # -- flags -------------------------------------------------------------
+    def _check_flags(self, index):
+        flags_sf = index.find("paddle_tpu/flags.py")
+        if flags_sf is None:
+            return
+        table = {}      # name -> line
+        for node in ast.walk(flags_sf.tree):
+            if isinstance(node, ast.Call) and node.args:
+                name = dotted_name(node.func)
+                if name and name.split(".")[-1] == "_register":
+                    flag = literal_str(node.args[0])
+                    if flag is not None:
+                        table.setdefault(flag, node.args[0].lineno)
+        if not table:
+            return
+        read = set()
+        for sf in index.iter_files():
+            if _skip(sf) or sf is flags_sf:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name = dotted_name(node.func)
+                tail = name.split(".")[-1] if name else None
+                if tail not in _FLAG_READS:
+                    continue
+                flag = literal_str(node.args[0])
+                if flag is None:
+                    continue
+                read.add(flag)
+                if flag not in table:
+                    yield Finding(
+                        self.name, ERROR, sf.path, node.lineno,
+                        "flag '%s' read via %s() but not registered "
+                        "in flags.py" % (flag, tail),
+                        hint="add a _register(...) entry with type, "
+                             "default and help text")
+        for flag in sorted(set(table) - read):
+            yield Finding(
+                self.name, INFO, flags_sf.path, table[flag],
+                "flag '%s' is registered but never read via a "
+                "literal get_flag/_flag call" % flag,
+                hint="env-only or dynamic use — confirm it is still "
+                     "live")
+
+
+register_runtime_rule(CatalogConsistencyRule)

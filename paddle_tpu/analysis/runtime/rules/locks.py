@@ -1,0 +1,414 @@
+"""RT01 lock-discipline: deadlock cycles + blocking calls under a lock.
+
+Per class, the rule reconstructs the lock set (``self.X =
+threading.Lock()/RLock()/Condition(...)``, with a Condition built over
+an existing lock aliased to that lock — acquiring the condition IS
+acquiring the lock) and walks every method with the held-lock context:
+
+  * ``with self.A:`` inside ``with self.B:`` records the edge B->A in
+    the class's lock-acquisition graph; a strongly connected component
+    (two orders of the same pair, or any longer cycle) is a potential
+    deadlock -> ERROR. A directly nested re-acquisition of one
+    NON-reentrant lock is an immediate self-deadlock -> ERROR.
+  * a blocking call while any lock is held -> ERROR. Blocking means:
+    socket I/O (send/recv/connect/accept and the rpc framing helpers
+    ``_send_msg``/``_recv_msg``/..., which are blocking wherever they
+    are imported), ``time.sleep``, thread ``join``, ``Event.wait``,
+    retry ``Policy.run`` (sleeps between attempts), and subprocess
+    waits. ``Condition.wait`` on the HELD condition is exempt — it
+    releases the lock while waiting, that is the correct pattern.
+  * blocking-ness propagates one class deep: ``self.m()`` under a lock
+    where ``m`` (transitively) blocks is flagged at the call site, and
+    locks ``m`` acquires become edges from the held lock.
+
+Module-level functions get the same treatment against module-level
+``_LOCK = threading.Lock()`` style globals, and a module function that
+blocks marks its bare-name callers within the module as blocking.
+"""
+
+import ast
+
+from ..astscan import (dotted_name, class_methods, iter_lock_scopes)
+from ..engine import (Finding, RuntimeRule, register_runtime_rule,
+                      ERROR, WARNING)
+
+__all__ = ["LockDisciplineRule"]
+
+# rpc framing / reply helpers: blocking socket I/O wherever imported
+KNOWN_BLOCKING = {
+    "_send_msg": "rpc framing _send_msg()",
+    "_recv_msg": "rpc framing _recv_msg()",
+    "_recv_exact": "rpc framing _recv_exact()",
+    "_recv_into": "rpc framing _recv_into()",
+    "_recv_frame_head": "rpc framing _recv_frame_head()",
+    "_sendall_parts": "rpc framing _sendall_parts()",
+    "_clock_reply": "rpc reply _clock_reply()",
+    "_metr_reply": "rpc reply _metr_reply()",
+    "_hlth_reply": "rpc reply _hlth_reply()",
+    "_clock_exchange": "rpc _clock_exchange()",
+    "create_connection": "socket.create_connection()",
+}
+
+_SOCKET_TAILS = {"sendall", "recv", "recv_into", "accept", "connect",
+                 "connect_ex", "sendmsg", "recvmsg"}
+_SUBPROC_TAILS = {"run", "call", "check_call", "check_output"}
+_LOCK_FACTORIES = {"Lock", "RLock"}
+
+
+def _call_parts(call):
+    """(tail, receiver_dotted_or_None) for a Call's func."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr, dotted_name(call.func.value)
+    if isinstance(call.func, ast.Name):
+        return call.func.id, None
+    return None, None
+
+
+def _factory_of(value):
+    """'Lock'/'RLock'/'Condition'/'Event'/'Thread' for an assignment
+    value like ``threading.Lock()``, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name is None:
+        return None
+    tail = name.split(".")[-1]
+    if tail in ("Lock", "RLock", "Condition", "Event", "Thread",
+                "Semaphore", "BoundedSemaphore"):
+        return tail
+    return None
+
+
+class _ClassInfo:
+    def __init__(self):
+        self.locks = {}      # attr -> canonical lock attr (alias-resolved)
+        self.rlocks = set()  # attrs that are reentrant
+        self.events = set()
+        self.threads = set()
+
+
+def _collect_class_info(cls):
+    info = _ClassInfo()
+    aliases = {}             # condition attr -> underlying lock attr
+    for fn in class_methods(cls).values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            fac = _factory_of(node.value)
+            if fac is None:
+                continue
+            for tgt in node.targets:
+                name = dotted_name(tgt)
+                if name is None or not name.startswith("self."):
+                    continue
+                attr = name.split(".", 1)[1]
+                if "." in attr:
+                    continue
+                if fac in _LOCK_FACTORIES:
+                    info.locks[attr] = attr
+                    if fac == "RLock":
+                        info.rlocks.add(attr)
+                elif fac in ("Semaphore", "BoundedSemaphore"):
+                    info.locks[attr] = attr
+                elif fac == "Condition":
+                    args = node.value.args
+                    base = dotted_name(args[0]) if args else None
+                    if base and base.startswith("self."):
+                        aliases[attr] = base.split(".", 1)[1]
+                    else:
+                        info.locks[attr] = attr
+                elif fac == "Event":
+                    info.events.add(attr)
+                elif fac == "Thread":
+                    info.threads.add(attr)
+    for attr, base in aliases.items():
+        info.locks[attr] = info.locks.get(base, base)
+        if base in info.rlocks:
+            info.rlocks.add(attr)
+    return info
+
+
+def _local_threads(fn):
+    """Local names bound to threading.Thread(...) in this function."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _factory_of(node.value) == "Thread":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _blocking_reason(call, info, module_blocking, local_threads):
+    """Why this call blocks, or None. ``info`` may be None for
+    module-level functions."""
+    tail, recv = _call_parts(call)
+    if tail is None:
+        return None
+    if recv == "time" and tail == "sleep":
+        return "time.sleep()"
+    if recv is None:
+        if tail in module_blocking:
+            return module_blocking[tail]
+        if tail in KNOWN_BLOCKING:
+            return KNOWN_BLOCKING[tail]
+        return None
+    if tail in _SOCKET_TAILS:
+        return "socket .%s()" % tail
+    if tail in KNOWN_BLOCKING and recv is not None:
+        # e.g. rpc._send_msg(...) via a module alias
+        if recv.split(".")[-1] in ("rpc", "_rpc"):
+            return KNOWN_BLOCKING[tail]
+    if tail == "join":
+        attr = recv.split(".", 1)[1] if recv.startswith("self.") else None
+        if (attr is not None and attr in (info.threads if info else ())) \
+                or recv in local_threads or "thread" in recv.lower():
+            return "thread .join()"
+        return None
+    if tail == "wait":
+        attr = recv.split(".", 1)[1] if recv.startswith("self.") else None
+        if info is not None and attr in info.events:
+            return "Event .wait()"
+        return None
+    if tail == "communicate" or (recv.split(".")[-1] == "subprocess"
+                                 and tail in _SUBPROC_TAILS):
+        return "subprocess .%s()" % tail
+    if tail == "run" and ("retry" in recv.lower()
+                          or "policy" in recv.lower()):
+        return "retry Policy.run()"
+    return None
+
+
+def _module_blocking_funcs(sf):
+    """{bare function name -> reason} for this module's top-level
+    functions that (transitively, within the module) block; seeded by
+    KNOWN_BLOCKING so callers of framing helpers propagate."""
+    funcs = {fn.name: fn for fn in sf.functions()}
+    blocking = dict(KNOWN_BLOCKING)
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in funcs.items():
+            if name in blocking:
+                continue
+            locals_t = _local_threads(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _blocking_reason(node, None, blocking, locals_t)
+                if reason is not None:
+                    blocking[name] = ("%s() -> %s" % (name, reason))
+                    changed = True
+                    break
+    return blocking
+
+
+def _sccs(graph):
+    """Strongly connected components with >1 node (iterative Tarjan
+    would be overkill at this scale: simple DFS reachability)."""
+    nodes = sorted(set(graph) | {w for vs in graph.values() for w, _ in vs})
+    reach = {}
+    for n in nodes:
+        seen = set()
+        stack = [w for w, _ in graph.get(n, ())]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(w for w, _ in graph.get(m, ()))
+        reach[n] = seen
+    comps, done = [], set()
+    for n in nodes:
+        if n in done:
+            continue
+        comp = {n} | {m for m in reach[n] if n in reach.get(m, ())}
+        if len(comp) > 1:
+            comps.append(sorted(comp))
+        done |= comp
+    return comps
+
+
+@register_runtime_rule
+class LockDisciplineRule(RuntimeRule):
+    name = "lock-discipline"
+    id = "RT01"
+    doc = ("per-class lock graph: acquisition cycles (deadlock) and "
+           "blocking calls (socket I/O, sleep, join, Policy.run, "
+           "subprocess) while a lock is held")
+    max_reports = 80
+
+    def check(self, index):
+        for sf in index.iter_files():
+            module_blocking = _module_blocking_funcs(sf)
+            # module-level locks + top-level functions
+            mod_locks = {}
+            for stmt in sf.tree.body:
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call) and \
+                        _factory_of(stmt.value) in _LOCK_FACTORIES:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            mod_locks[tgt.id] = tgt.id
+            if mod_locks:
+                for fn in sf.functions():
+                    for f in self._check_callable(
+                            sf, fn, fn.name, None, mod_locks,
+                            module_blocking, {}, {}, {}, {}):
+                        yield f
+            for cls_node in sf.classes():
+                for f in self._check_class(sf, cls_node,
+                                           module_blocking):
+                    yield f
+
+    # -- per-class ---------------------------------------------------------
+    def _check_class(self, sf, cls, module_blocking):
+        info = _collect_class_info(cls)
+        methods = class_methods(cls)
+        if not info.locks:
+            return
+        edges = {}           # lock -> [(lock2, line)]
+        acquires = {}        # method -> set of locks acquired inside
+        blocking_sites = {}  # method -> [(line, reason, held)]
+        self_calls = {}      # method -> [(line, callee, held)]
+        for mname, fn in methods.items():
+            self._scan_method(sf, cls, fn, info, module_blocking,
+                              edges, acquires.setdefault(mname, set()),
+                              blocking_sites.setdefault(mname, []),
+                              self_calls.setdefault(mname, []))
+        # propagate blocking-ness through self.m() calls (fixed point)
+        blocking_method = {}
+        changed = True
+        while changed:
+            changed = False
+            for mname in methods:
+                if mname in blocking_method:
+                    continue
+                if blocking_sites[mname]:
+                    blocking_method[mname] = blocking_sites[mname][0][1]
+                    changed = True
+                    continue
+                for _ln, callee, _held in self_calls[mname]:
+                    if callee in blocking_method:
+                        blocking_method[mname] = ("self.%s() -> %s"
+                                                  % (callee,
+                                                     blocking_method[callee]))
+                        changed = True
+                        break
+        # transitive acquires (one fixed point, same shape)
+        changed = True
+        while changed:
+            changed = False
+            for mname in methods:
+                for _ln, callee, _held in self_calls[mname]:
+                    extra = acquires.get(callee, set()) - acquires[mname]
+                    if extra:
+                        acquires[mname] |= extra
+                        changed = True
+        # findings: blocking under a held lock
+        for mname in sorted(methods):
+            where = "%s.%s" % (cls.name, mname)
+            for ln, reason, held in blocking_sites[mname]:
+                if held:
+                    yield Finding(
+                        self.name, ERROR, sf.path, ln,
+                        "blocking call %s while holding lock '%s'"
+                        % (reason, held[-1]), where=where,
+                        hint="compute the reply under the lock, do the "
+                             "I/O after releasing it")
+            for ln, callee, held in self_calls[mname]:
+                if held and callee in blocking_method:
+                    yield Finding(
+                        self.name, ERROR, sf.path, ln,
+                        "call to self.%s() (%s) while holding lock '%s'"
+                        % (callee, blocking_method[callee], held[-1]),
+                        where=where,
+                        hint="move the call after the lock release")
+                if held:
+                    for lk2 in sorted(acquires.get(callee, ())):
+                        edges.setdefault(held[-1], []).append((lk2, ln))
+        # findings: same-lock re-acquisition + cycles
+        for lk, outs in sorted(edges.items()):
+            for lk2, ln in outs:
+                if lk2 == lk and lk not in info.rlocks:
+                    yield Finding(
+                        self.name, ERROR, sf.path, ln,
+                        "nested re-acquisition of non-reentrant lock "
+                        "'%s'" % lk, where=cls.name,
+                        hint="use threading.RLock or split the method")
+        graph = {lk: [(l2, ln) for l2, ln in outs if l2 != lk]
+                 for lk, outs in edges.items()}
+        for comp in _sccs(graph):
+            first_line = min(ln for lk in comp
+                             for l2, ln in graph.get(lk, ())
+                             if l2 in comp)
+            yield Finding(
+                self.name, ERROR, sf.path, first_line,
+                "lock-order cycle: %s" % " -> ".join(comp + [comp[0]]),
+                where=cls.name,
+                hint="pick one acquisition order and stick to it")
+
+    def _scan_method(self, sf, cls, fn, info, module_blocking, edges,
+                     acquires, blocking_sites, self_calls):
+        locals_t = _local_threads(fn)
+        methods = class_methods(cls)
+
+        def lock_of(expr):
+            name = dotted_name(expr)
+            if name and name.startswith("self."):
+                attr = name.split(".", 1)[1]
+                return info.locks.get(attr)
+            return None
+
+        for kind, node, held, lk in iter_lock_scopes(fn.body, lock_of):
+            if kind == "acquire":
+                acquires.add(lk)
+                if held:
+                    edges.setdefault(held[-1], []).append(
+                        (lk, node.lineno))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            tail, recv = _call_parts(node)
+            # Condition.wait on the held condition releases the lock
+            reason = _blocking_reason(node, info, module_blocking,
+                                      locals_t)
+            if reason is not None:
+                blocking_sites.append((node.lineno, reason, held))
+            elif recv == "self" and tail in methods:
+                self_calls.append((node.lineno, tail, held))
+            elif tail == "acquire" and recv and recv.startswith("self."):
+                attr = recv.split(".", 1)[1]
+                lk2 = info.locks.get(attr)
+                if lk2 is not None:
+                    acquires.add(lk2)
+                    if held:
+                        edges.setdefault(held[-1], []).append(
+                            (lk2, node.lineno))
+
+    # -- module-level functions against module locks -----------------------
+    def _check_callable(self, sf, fn, where, info, mod_locks,
+                        module_blocking, edges, acquires,
+                        blocking_sites, self_calls):
+        locals_t = _local_threads(fn)
+
+        def lock_of(expr):
+            if isinstance(expr, ast.Name):
+                return mod_locks.get(expr.id)
+            return None
+
+        for kind, node, held, lk in iter_lock_scopes(fn.body, lock_of):
+            if kind == "acquire" or not isinstance(node, ast.Call):
+                continue
+            reason = _blocking_reason(node, info, module_blocking,
+                                      locals_t)
+            if reason is not None and held:
+                yield Finding(
+                    self.name, ERROR, sf.path, node.lineno,
+                    "blocking call %s while holding lock '%s'"
+                    % (reason, held[-1]), where=where,
+                    hint="compute under the lock, do the I/O after "
+                         "releasing it")

@@ -1,0 +1,106 @@
+"""RT04 thread-shared-state: unlocked mutation heuristic (INFO).
+
+A class that spawns threads (``threading.Thread`` assigned to an
+attribute or a local) has every method as a potential thread entry
+point. For such classes, an instance attribute that is ASSIGNED
+(``self.x = ...`` / ``self.x += ...``) in two or more methods besides
+``__init__``, with at least one of those assignments outside any
+``with self.<lock>:`` scope, is a data-race candidate: two entry
+points race on the same slot and no lock covers one of them.
+
+This is deliberately a HEURISTIC at INFO severity — single-writer
+designs, monotonic flags and benign races are common and fine — so it
+never gates the build; it exists to make the review checklist
+mechanical (the PR-11 class of bug: a collector attribute written from
+the scrape thread and the request thread with the lock on one side
+only). Lock/event/thread attributes themselves are exempt.
+"""
+
+import ast
+
+from ..astscan import dotted_name, class_methods, iter_lock_scopes
+from ..engine import Finding, RuntimeRule, register_runtime_rule, INFO
+from .locks import _collect_class_info, _factory_of
+
+__all__ = ["ThreadSharedStateRule"]
+
+
+def _spawns_threads(cls, info):
+    if info.threads:
+        return True
+    for fn in class_methods(cls).values():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    _factory_of(node) == "Thread":
+                return True
+    return False
+
+
+@register_runtime_rule
+class ThreadSharedStateRule(RuntimeRule):
+    name = "thread-shared-state"
+    id = "RT04"
+    doc = ("attributes of thread-spawning classes assigned from >=2 "
+           "methods with at least one site outside any lock (INFO "
+           "heuristic, never gates)")
+    max_reports = 40
+
+    def check(self, index):
+        for sf, cls in index.iter_classes():
+            info = _collect_class_info(cls)
+            if not _spawns_threads(cls, info):
+                continue
+            exempt = (set(info.locks) | set(info.events)
+                      | set(info.threads))
+            # attr -> {method: (line, held?)}
+            writes = {}
+            for mname, fn in class_methods(cls).items():
+
+                def lock_of(expr):
+                    name = dotted_name(expr)
+                    if name and name.startswith("self."):
+                        return info.locks.get(name.split(".", 1)[1])
+                    return None
+
+                for kind, node, held, _lk in iter_lock_scopes(
+                        fn.body, lock_of):
+                    if kind != "node":
+                        continue
+                    targets = ()
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                    elif isinstance(node, ast.AugAssign):
+                        targets = (node.target,)
+                    for tgt in targets:
+                        name = dotted_name(tgt)
+                        if not name or not name.startswith("self."):
+                            continue
+                        attr = name.split(".", 1)[1]
+                        if "." in attr or attr in exempt:
+                            continue
+                        cur = writes.setdefault(attr, {})
+                        prev = cur.get(mname)
+                        # keep the unlocked site if any
+                        if prev is None or (prev[1] and not held):
+                            cur[mname] = (node.lineno, bool(held))
+            for attr in sorted(writes):
+                sites = writes[attr]
+                methods = {m for m in sites if m != "__init__"}
+                if len(methods) < 2:
+                    continue
+                unlocked = sorted(
+                    (sites[m][0], m) for m in methods
+                    if not sites[m][1])
+                if not unlocked:
+                    continue
+                line, meth = unlocked[0]
+                others = sorted(m for m in methods if m != meth)
+                yield Finding(
+                    self.name, INFO, sf.path, line,
+                    "attribute 'self.%s' of thread-spawning class "
+                    "'%s' is assigned in %d methods but not under a "
+                    "lock here" % (attr, cls.name, len(methods)),
+                    where="%s.%s" % (cls.name, meth),
+                    hint="also written in: %s — take the instance "
+                         "lock or document the single-writer "
+                         "invariant" % ", ".join(others))

@@ -1,0 +1,321 @@
+"""RT02 verb-conformance: dispatch loops vs fault/retry tables + trace.
+
+The wire protocol's request-verb universe is extracted structurally:
+a DISPATCHER is any function comparing a variable named ``op`` against
+>=3 distinct verb literals (``op == "SEND"`` / ``op in ("SEND",
+"PUT")``), or a ``handle`` method with >=1 verb comparison that also
+calls the rpc framing receive helpers (the pre-dispatch CHNK/EXIT
+fast paths live there). Pure reply verbs (OK/VAL/ERR/MISS/NONE/TASK/
+STLE/BADR) never reach server dispatch comparisons and are excluded,
+so client-side reply checks don't pollute the universe.
+
+Every dispatch verb must then be:
+
+  * covered by ``resilience/faults._DEFAULT_OPS`` (the fault-injection
+    verb table) unless its retry class is ``admin`` — ERROR names the
+    missing table, so a new verb that forgets the chaos tier fails CI;
+  * classified in ``resilience/retry.VERB_CLASSES`` as one of
+    idempotent / round_tag / nonretryable / admin — the machine-
+    readable form of the retry-idempotence contract the clients rely
+    on — ERROR otherwise;
+  * served by a trace-header-aware loop: the dispatcher's enclosing
+    handler must consume the propagated span context
+    (``want_ctx=True`` / ``_recv_frame_head`` / ``server_span``) —
+    WARNING otherwise.
+
+Stale table entries (a verb in either table that no dispatch loop
+serves) are WARNINGs anchored at the table, so deleting a verb cleans
+the tables too. Both tables are read by literal AST extraction — the
+lint never imports the runtime.
+"""
+
+import ast
+import re
+
+from ..astscan import dotted_name, literal_str
+from ..engine import (Finding, RuntimeRule, register_runtime_rule,
+                      ERROR, WARNING)
+
+__all__ = ["VerbConformanceRule"]
+
+_VERB_RE = re.compile(r"^[A-Z]{2,5}$")
+
+# reply-channel verbs: sent with _send_msg, never compared in a server
+# dispatch loop ("FAIL" is BOTH a master request verb and a KV reply,
+# so it stays in the universe when seen in a qualifying dispatcher)
+REPLY_VERBS = frozenset({"OK", "VAL", "ERR", "MISS", "NONE", "TASK",
+                         "STLE", "BADR"})
+
+VALID_CLASSES = ("idempotent", "round_tag", "nonretryable", "admin")
+
+_RECV_HELPERS = {"_recv_msg", "_recv_frame_head"}
+
+
+def _own_nodes(fn):
+    """ast.walk over ``fn`` excluding nested function/class bodies —
+    comparisons belong to their innermost scope (the handler classes
+    are nested inside server constructors)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _verb_comparisons(fn):
+    """[(verb, line)] for ``op == "X"`` / ``op in ("X", "Y")`` in the
+    function's own scope."""
+    out = []
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not (isinstance(node.left, ast.Name)
+                and node.left.id == "op"):
+            continue
+        cmp_node = node.comparators[0]
+        if isinstance(node.ops[0], ast.Eq):
+            v = literal_str(cmp_node)
+            if v is not None and _VERB_RE.match(v):
+                out.append((v, node.lineno))
+        elif isinstance(node.ops[0], ast.In) and \
+                isinstance(cmp_node, (ast.Tuple, ast.List, ast.Set)):
+            for elt in cmp_node.elts:
+                v = literal_str(elt)
+                if v is not None and _VERB_RE.match(v):
+                    out.append((v, node.lineno))
+    return out
+
+
+def _calls_recv_helper(fn):
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.split(".")[-1] in _RECV_HELPERS:
+                return True
+    return False
+
+
+def _call_tails(fn):
+    """Bare tails of every call in the function's own scope."""
+    out = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name:
+                out.add(name.split(".")[-1])
+    return out
+
+
+def _consumes_trace_ctx(fn):
+    """The handler threads the propagated span context: passes
+    ``want_ctx=True`` to _recv_msg, calls _recv_frame_head (which
+    always yields ctx), or opens a server_span itself."""
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        tail = name.split(".")[-1] if name else None
+        if tail == "_recv_frame_head" or tail == "server_span":
+            return True
+        if tail == "_recv_msg":
+            for kw in node.keywords:
+                if kw.arg == "want_ctx" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is True:
+                    return True
+    return False
+
+
+def _extract_frozenset(sf, var):
+    """Literal frozenset({...}) assigned to ``var`` at module level."""
+    if sf is None:
+        return None
+    for stmt in sf.tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == var
+                   for t in stmt.targets):
+            continue
+        val = stmt.value
+        if isinstance(val, ast.Call) and \
+                dotted_name(val.func) == "frozenset" and val.args:
+            val = val.args[0]
+        try:
+            lit = ast.literal_eval(val)
+        except ValueError:
+            return None
+        return frozenset(lit), stmt.lineno
+    return None
+
+
+def _extract_dict(sf, var):
+    if sf is None:
+        return None
+    for stmt in sf.tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == var
+                   for t in stmt.targets):
+            continue
+        try:
+            lit = ast.literal_eval(stmt.value)
+        except ValueError:
+            return None
+        if isinstance(lit, dict):
+            return lit, stmt.lineno
+    return None
+
+
+class _Dispatcher:
+    def __init__(self, sf, qualname, fn, verbs):
+        self.sf = sf
+        self.qualname = qualname
+        self.fn = fn
+        self.verbs = verbs   # {verb: first line}
+
+
+def _all_scopes(sf):
+    """Every function def in the file, any nesting depth, with its
+    dotted qualname (e.g. ``VariableServer.serve.Handler.handle``)."""
+    out = []
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                qual = prefix + child.name
+                out.append((qual, child))
+                visit(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, prefix + child.name + ".")
+
+    visit(sf.tree, "")
+    return out
+
+
+def _find_dispatchers(index):
+    out = []
+    for sf in index.iter_files():
+        for qualname, fn in _all_scopes(sf):
+            comps = _verb_comparisons(fn)
+            if not comps:
+                continue
+            request_verbs = {v for v, _ in comps
+                             if v not in REPLY_VERBS}
+            qualifies = len(request_verbs) >= 3 or (
+                fn.name == "handle" and request_verbs
+                and _calls_recv_helper(fn))
+            if not qualifies:
+                continue
+            verbs = {}
+            for v, ln in comps:
+                if v in REPLY_VERBS:
+                    continue
+                verbs.setdefault(v, ln)
+            out.append(_Dispatcher(sf, qualname, fn, verbs))
+    return out
+
+
+@register_runtime_rule
+class VerbConformanceRule(RuntimeRule):
+    name = "verb-conformance"
+    id = "RT02"
+    doc = ("every RPC dispatch verb covered by faults._DEFAULT_OPS, "
+           "classified in retry.VERB_CLASSES, and served by a "
+           "trace-aware handler; stale table entries flagged")
+    max_reports = 60
+
+    def check(self, index):
+        faults_sf = index.find("resilience/faults.py")
+        retry_sf = index.find("resilience/retry.py")
+        ops = _extract_frozenset(faults_sf, "_DEFAULT_OPS")
+        classes = _extract_dict(retry_sf, "VERB_CLASSES")
+        dispatchers = _find_dispatchers(index)
+        if ops is None:
+            anchor = faults_sf or (dispatchers[0].sf if dispatchers
+                                   else None)
+            if anchor is not None:
+                yield Finding(
+                    self.name, ERROR, anchor.path, 1,
+                    "fault-injection verb table resilience/faults."
+                    "_DEFAULT_OPS not found (literal frozenset "
+                    "expected)")
+            ops = (frozenset(), 1)
+        if classes is None:
+            anchor = retry_sf or (dispatchers[0].sf if dispatchers
+                                  else None)
+            if anchor is not None:
+                yield Finding(
+                    self.name, ERROR, anchor.path, 1,
+                    "retry idempotence table resilience/retry."
+                    "VERB_CLASSES not found (literal dict expected)")
+            classes = ({}, 1)
+        default_ops, ops_line = ops
+        verb_classes, classes_line = classes
+
+        served = {}
+        for d in dispatchers:
+            for v, ln in sorted(d.verbs.items()):
+                served.setdefault(v, (d, ln))
+                cls_val = verb_classes.get(v)
+                if cls_val is None:
+                    yield Finding(
+                        self.name, ERROR, d.sf.path, ln,
+                        "dispatch verb '%s' has no retry idempotence "
+                        "class in resilience/retry.VERB_CLASSES" % v,
+                        where=d.qualname,
+                        hint="classify it: idempotent | round_tag | "
+                             "nonretryable | admin")
+                elif cls_val not in VALID_CLASSES:
+                    yield Finding(
+                        self.name, ERROR, d.sf.path, ln,
+                        "dispatch verb '%s' has invalid retry class "
+                        "%r (expected one of %s)"
+                        % (v, cls_val, "/".join(VALID_CLASSES)),
+                        where=d.qualname)
+                if v not in default_ops and cls_val != "admin":
+                    yield Finding(
+                        self.name, ERROR, d.sf.path, ln,
+                        "dispatch verb '%s' missing from resilience/"
+                        "faults._DEFAULT_OPS — the chaos tier cannot "
+                        "fault it" % v, where=d.qualname,
+                        hint="add it to the _DEFAULT_OPS frozenset "
+                             "(or classify it 'admin')")
+            # trace-header reachability: the dispatcher consumes the
+            # span context itself, or a ctx-aware ``handle`` in the
+            # same file calls into it (the nested Handler classes)
+            aware = _consumes_trace_ctx(d.fn)
+            if not aware:
+                for _q, fn in _all_scopes(d.sf):
+                    if fn.name == "handle" and \
+                            _consumes_trace_ctx(fn) and \
+                            d.fn.name in _call_tails(fn):
+                        aware = True
+                        break
+            if not aware:
+                yield Finding(
+                    self.name, WARNING, d.sf.path, d.fn.lineno,
+                    "dispatch loop is not reachable by the trace "
+                    "header path (no want_ctx=True / _recv_frame_head "
+                    "/ server_span in the handler)",
+                    where=d.qualname,
+                    hint="thread the propagated span context through "
+                         "the receive path")
+        # stale table entries
+        if faults_sf is not None:
+            for v in sorted(default_ops - set(served)):
+                yield Finding(
+                    self.name, WARNING, faults_sf.path, ops_line,
+                    "faults._DEFAULT_OPS covers verb '%s' that no "
+                    "dispatch loop serves" % v,
+                    hint="stale entry — delete it or wire the verb")
+        if retry_sf is not None:
+            for v in sorted(set(verb_classes) - set(served)):
+                yield Finding(
+                    self.name, WARNING, retry_sf.path, classes_line,
+                    "retry.VERB_CLASSES classifies verb '%s' that no "
+                    "dispatch loop serves" % v,
+                    hint="stale entry — delete it or wire the verb")

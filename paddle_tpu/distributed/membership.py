@@ -145,19 +145,24 @@ class KVServer:
                           json.dumps({"value": ent[0]}).encode())
         elif op == "CAS":
             # old == None → create-if-absent (etcd CompareAndSwap with
-            # prevExist=false, etcd_client.go:70)
+            # prevExist=false, etcd_client.go:70). The swap is decided
+            # under the lock; the reply is sent after releasing it — a
+            # slow reader must not serialize every other KV handler
+            # (analysis --runtime, lock-discipline)
             ttl = body.get("ttl")
             with self._lock:
                 ent = self._alive(name)
                 cur = ent[0] if ent is not None else None
-                if cur == body.get("old"):
+                swapped = cur == body.get("old")
+                if swapped:
                     self._data[name] = (
                         body["new"],
                         time.time() + ttl if ttl else None)
-                    _send_msg(sock, "OK")
-                else:
-                    _send_msg(sock, "FAIL", name,
-                              json.dumps({"value": cur}).encode())
+            if swapped:
+                _send_msg(sock, "OK")
+            else:
+                _send_msg(sock, "FAIL", name,
+                          json.dumps({"value": cur}).encode())
         elif op == "DEL":
             with self._lock:
                 self._data.pop(name, None)
@@ -168,11 +173,13 @@ class KVServer:
             # owner's registration (etcd DeleteIfValue semantics)
             with self._lock:
                 ent = self._alive(name)
-                if ent is not None and ent[0] == body.get("old"):
+                deleted = ent is not None and ent[0] == body.get("old")
+                if deleted:
                     self._data.pop(name, None)
-                    _send_msg(sock, "OK")
-                else:
-                    _send_msg(sock, "FAIL", name)
+            if deleted:
+                _send_msg(sock, "OK")
+            else:
+                _send_msg(sock, "FAIL", name)
         elif op == "LIST":
             with self._lock:
                 now = time.time()
@@ -188,14 +195,17 @@ class KVServer:
             expect = body.get("expect")
             with self._lock:
                 ent = self._alive(name)
-                if ent is None:
-                    _send_msg(sock, "MISS", name)
-                elif expect is not None and ent[0] != expect:
-                    _send_msg(sock, "FAIL", name,
-                              json.dumps({"value": ent[0]}).encode())
-                else:
+                usurped = (ent is not None and expect is not None
+                           and ent[0] != expect)
+                if ent is not None and not usurped:
                     self._data[name] = (ent[0], time.time() + ttl)
-                    _send_msg(sock, "OK")
+            if ent is None:
+                _send_msg(sock, "MISS", name)
+            elif usurped:
+                _send_msg(sock, "FAIL", name,
+                          json.dumps({"value": ent[0]}).encode())
+            else:
+                _send_msg(sock, "OK")
         elif op == "CLKS":
             _clock_reply(sock)
         elif op == "METR":

@@ -609,27 +609,35 @@ class VariableServer:
                 name, tag = name.split("||", 1)
             pref, seq = _parse_tag(tag)
             if self.sync:
+                # decide under the lock, reply after releasing it:
+                # _send_msg blocks on the socket and a slow reader must
+                # not stall every other handler thread on self._lock
+                # (enforced by analysis --runtime, lock-discipline)
                 with self._lock:
                     stale = (self._stale_epoch(pref)
                              if pref is not None else None)
-                    if stale is not None:
-                        _PS_STALE.inc()
-                        _send_msg(sock, "STLE", name, json.dumps(
-                            {"max_epoch": stale}).encode())
-                        return
-                    if pref is not None and \
-                            seq <= self._applied.get(pref, -1):
-                        _send_msg(sock, "OK")   # round already applied
-                        return
-                    if pref is not None:
-                        self._evict_stale_incarnation(pref)
-                    slot = self.grads.setdefault(name, {})
-                    # untagged sends get a monotonic key, never reused:
-                    # len(slot) could collide with a live key after an
-                    # eviction shrank the dict, silently replacing a
-                    # pending grad that should accumulate
-                    slot[tag if tag is not None
-                         else "#%d" % next(self._untagged_seq)] = value
+                    applied = (stale is None and pref is not None
+                               and seq <= self._applied.get(pref, -1))
+                    if stale is None and not applied:
+                        if pref is not None:
+                            self._evict_stale_incarnation(pref)
+                        slot = self.grads.setdefault(name, {})
+                        # untagged sends get a monotonic key, never
+                        # reused: len(slot) could collide with a live
+                        # key after an eviction shrank the dict,
+                        # silently replacing a pending grad that
+                        # should accumulate
+                        slot[tag if tag is not None
+                             else "#%d" % next(self._untagged_seq)] \
+                            = value
+                if stale is not None:
+                    _PS_STALE.inc()
+                    _send_msg(sock, "STLE", name, json.dumps(
+                        {"max_epoch": stale}).encode())
+                    return
+                if applied:
+                    _send_msg(sock, "OK")   # round already applied
+                    return
             else:
                 # Async SGD (ParameterServer2.h async paths /
                 # async_update.md): apply this gradient immediately under
@@ -768,54 +776,58 @@ class VariableServer:
         together with tagged SENDs this makes at-least-once trainer
         retries exactly-once per round."""
         pref, seq = _parse_tag(tag)
+        # the early replies (stale / already-applied) are decided under
+        # the condition's lock but SENT after releasing it — socket
+        # writes must never hold up the round for every other handler
+        # (enforced by analysis --runtime, lock-discipline)
         with self._round_cv:
             stale = self._stale_epoch(pref) if pref is not None else None
-            if stale is not None:
-                _PS_STALE.inc()
-                _send_msg(sock, "STLE", tag or "", json.dumps(
-                    {"max_epoch": stale}).encode())
-                return
-            if pref is not None and seq <= self._applied.get(pref, -1):
-                _send_msg(sock, "OK")   # this round already completed
-                return
-            if pref is not None:
-                self._evict_stale_incarnation(pref)
-            my_round = self._round
-            counted = not (tag and tag in self._barr_seen)
-            if counted:
-                if tag:
-                    self._barr_seen.add(tag)
-                self._barrier_count += 1
-            if self._barrier_count >= self.fan_in:
-                grads, self.grads = self.grads, {}
-                merged = {}
-                for name, slot in grads.items():
-                    glist = list(slot.values())
-                    if not glist:      # fully evicted (stale incarnation)
-                        continue
-                    acc = glist[0]
-                    for g in glist[1:]:
-                        if isinstance(acc, SelectedRows):
-                            acc = acc.merge(g)
-                        else:
-                            acc = acc + g
-                    merged[name] = acc
-                if self.optimize_fn is not None:
-                    self.optimize_fn(self.store, merged)
-                for t in self._barr_seen:
-                    p, s = _parse_tag(t)
-                    if p is not None:
-                        self._applied[p] = max(self._applied.get(p, -1),
-                                               s)
-                self._barrier_count = 0
-                self._barr_seen = set()
-                self._round += 1
-                _PS_ROUNDS.inc()
-                self._round_cv.notify_all()
-            else:
-                while (self._round == my_round
-                       and not self._shutdown.is_set()):
-                    self._round_cv.wait(timeout=0.1)
+            applied = (stale is None and pref is not None
+                       and seq <= self._applied.get(pref, -1))
+            if stale is None and not applied:
+                if pref is not None:
+                    self._evict_stale_incarnation(pref)
+                my_round = self._round
+                counted = not (tag and tag in self._barr_seen)
+                if counted:
+                    if tag:
+                        self._barr_seen.add(tag)
+                    self._barrier_count += 1
+                if self._barrier_count >= self.fan_in:
+                    grads, self.grads = self.grads, {}
+                    merged = {}
+                    for name, slot in grads.items():
+                        glist = list(slot.values())
+                        if not glist:  # fully evicted (stale incarnation)
+                            continue
+                        acc = glist[0]
+                        for g in glist[1:]:
+                            if isinstance(acc, SelectedRows):
+                                acc = acc.merge(g)
+                            else:
+                                acc = acc + g
+                        merged[name] = acc
+                    if self.optimize_fn is not None:
+                        self.optimize_fn(self.store, merged)
+                    for t in self._barr_seen:
+                        p, s = _parse_tag(t)
+                        if p is not None:
+                            self._applied[p] = max(
+                                self._applied.get(p, -1), s)
+                    self._barrier_count = 0
+                    self._barr_seen = set()
+                    self._round += 1
+                    _PS_ROUNDS.inc()
+                    self._round_cv.notify_all()
+                else:
+                    while (self._round == my_round
+                           and not self._shutdown.is_set()):
+                        self._round_cv.wait(timeout=0.1)
+        if stale is not None:
+            _PS_STALE.inc()
+            _send_msg(sock, "STLE", tag or "", json.dumps(
+                {"max_epoch": stale}).encode())
+            return
         _send_msg(sock, "OK")
 
 

@@ -79,31 +79,48 @@ class TelemetryServer:
         from ..distributed.rpc import (_recv_msg, _send_msg,
                                        _clock_reply, _metr_reply,
                                        _hlth_reply)
+        from ..trace import runtime as _trace
         self.role = role
         self.registry = registry         # None -> global at call time
         outer = self
 
+        def _serve(request, op, payload):
+            if op == "METR":
+                _metr_reply(request, payload, role=outer.role,
+                            registry=outer.registry)
+            elif op == "HLTH":
+                _hlth_reply(request, role=outer.role,
+                            registry=outer.registry)
+            elif op == "CLKS":
+                _clock_reply(request)
+            elif op == "EXIT":
+                _send_msg(request, "OK")
+                outer.stop()
+                return False
+            else:
+                _send_msg(request, "ERR", "unknown op %s" % op)
+            return True
+
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # same trace-header path as every other dispatch loop
+                # (master/kv/replica): a traced scrape nests under the
+                # collector's client span
                 try:
                     while True:
-                        op, name, payload = _recv_msg(self.request)
-                        if op == "METR":
-                            _metr_reply(self.request, payload,
-                                        role=outer.role,
-                                        registry=outer.registry)
-                        elif op == "HLTH":
-                            _hlth_reply(self.request, role=outer.role,
-                                        registry=outer.registry)
-                        elif op == "CLKS":
-                            _clock_reply(self.request)
-                        elif op == "EXIT":
-                            _send_msg(self.request, "OK")
-                            outer.stop()
-                            break
+                        op, name, payload, tctx = _recv_msg(
+                            self.request, want_ctx=True)
+                        trc = _trace._TRACER
+                        if trc is not None and tctx is not None \
+                                and op != "CLKS":
+                            with trc.server_span("telemetry." + op,
+                                                 tctx, op=op):
+                                cont = _serve(self.request, op,
+                                              payload)
                         else:
-                            _send_msg(self.request, "ERR",
-                                      "unknown op %s" % op)
+                            cont = _serve(self.request, op, payload)
+                        if not cont:
+                            break
                 except (ConnectionError, OSError):
                     pass
 

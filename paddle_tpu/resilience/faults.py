@@ -58,12 +58,16 @@ __all__ = ["FaultPlan", "arm", "disarm", "active", "maybe_arm_from_flags",
            "corrupt_file"]
 
 # request verbs of the rpc/master/kv protocols; replies (OK/VAL/...)
-# are excluded by default so a plan faults requests unless it opts in
+# are excluded by default so a plan faults requests unless it opts in.
+# Every dispatch loop's verbs must appear here (or be classified
+# 'admin' in resilience.retry.VERB_CLASSES) — enforced by
+# `python -m paddle_tpu.analysis --runtime` (verb-conformance).
 _DEFAULT_OPS = frozenset({
     "SEND", "PUT", "GET", "PRFT", "BARR", "CHNK",        # pserver
     "GETT", "DONE", "FAIL", "PING",                      # master
     "CAS", "DEL", "CAD", "LIST", "LEAS",                 # kv store
     "SUBM", "POLL", "CANC", "STAT",                      # serving fleet
+    "CLKS", "METR", "HLTH",       # clock/telemetry (every dispatcher)
 })
 
 _SEND_KINDS = ("drop", "close_mid_frame", "duplicate", "delay")

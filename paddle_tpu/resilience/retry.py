@@ -28,11 +28,47 @@ import time
 
 from ..monitor import runtime as _mon
 
-__all__ = ["Policy", "default_policy", "RETRYABLE"]
+__all__ = ["Policy", "default_policy", "RETRYABLE", "VERB_CLASSES"]
 
 # TimeoutError covers socket.timeout (an alias since 3.10); both are
 # OSError subclasses, listed for readers, matched as one family.
 RETRYABLE = (ConnectionError, TimeoutError, OSError)
+
+# The retry-idempotence contract, one entry per request verb — the
+# machine-readable form of the rules the clients implement by hand
+# (RPCClient._retrying call sites, MasterClient, ReplicaClient's
+# journal dedup). `python -m paddle_tpu.analysis --runtime`
+# (verb-conformance) checks every dispatch loop's verbs against this
+# table, so a new verb MUST take a position on re-issue safety:
+#
+#   idempotent     blind re-issue after a lost reply is safe (reads,
+#                  last-writer-wins puts, journal-deduped fleet verbs)
+#   round_tag      safe ONLY when carrying a ROUND-format tag the
+#                  server dedups (untagged SEND/BARR double-applies)
+#   nonretryable   never re-issued blindly (CAS/CAD: a lost reply
+#                  leaves compare-and-X outcomes ambiguous; CHNK:
+#                  side-stream parts are re-sent by the commit SEND)
+#   admin          connection/shutdown control, excluded from fault
+#                  injection and retry alike
+VERB_CLASSES = {
+    # pserver (distributed/rpc.py)
+    "SEND": "round_tag", "BARR": "round_tag",
+    "PUT": "idempotent", "GET": "idempotent", "PRFT": "idempotent",
+    "CHNK": "nonretryable",
+    # master task queue (distributed/master.py)
+    "GETT": "idempotent", "DONE": "idempotent", "FAIL": "idempotent",
+    "PING": "idempotent",
+    # membership KV (distributed/membership.py; PUT/GET shared above)
+    "CAS": "nonretryable", "CAD": "nonretryable",
+    "DEL": "idempotent", "LIST": "idempotent", "LEAS": "idempotent",
+    # serving fleet (serving/fleet.py): exactly-once via the request
+    # journal, so EVERY verb is idempotent by construction
+    "SUBM": "idempotent", "POLL": "idempotent", "CANC": "idempotent",
+    "STAT": "idempotent",
+    # clock/telemetry reads served by every dispatcher + shutdown
+    "CLKS": "idempotent", "METR": "idempotent", "HLTH": "idempotent",
+    "EXIT": "admin",
+}
 
 
 class Policy:

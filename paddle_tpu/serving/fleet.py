@@ -306,6 +306,7 @@ class ReplicaServer:
         self._maybe_fault()
         if op == "SUBM":
             body = json.loads(bytes(payload).decode())
+            bad = None
             with self._lock:
                 self._prune_locked(time.time())
                 if name not in self._jobs:
@@ -332,16 +333,23 @@ class ReplicaServer:
                         # that mixed replica kinds under one role):
                         # a typed reply — NOT a torn connection — so
                         # the router fails it terminally instead of
-                        # retrying it into every replica in turn
-                        _send_msg(sock, "BADR", name, repr(e).encode())
-                        return
+                        # retrying it into every replica in turn.
+                        # Sent below, after the lock: a slow reader
+                        # must not stall the other handler threads
+                        # (analysis --runtime, lock-discipline).
+                        bad = repr(e).encode()
                     except RuntimeError as e:
                         # engine closed (replica dying): tear the
                         # connection — the router retries elsewhere
                         raise ConnectionError(
                             "replica engine unavailable: %s" % e)
-                    self._jobs[name] = {"req": req, "t0": time.time()}
-                    self._accepted += 1
+                    else:
+                        self._jobs[name] = {"req": req,
+                                            "t0": time.time()}
+                        self._accepted += 1
+            if bad is not None:
+                _send_msg(sock, "BADR", name, bad)
+                return
             _send_msg(sock, "OK", name)
         elif op == "POLL":
             body = json.loads(bytes(payload).decode()) if payload else {}

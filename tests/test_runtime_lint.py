@@ -1,0 +1,422 @@
+"""Golden-fixture tests for paddle_tpu.analysis.runtime (the
+``--runtime`` lint): one deliberately broken toy module per rule (each
+must produce exactly the pinned finding), the waiver machinery (match,
+stale, unmatched, malformed), CLI exit codes, and the tier-1 gate —
+``python -m paddle_tpu.analysis --runtime`` must exit 0 at HEAD.
+
+Also pins the verb-table drift fixes this tier caught at introduction:
+CLKS/METR/HLTH in ``faults._DEFAULT_OPS`` and a total
+``retry.VERB_CLASSES`` classification.
+"""
+
+import json
+
+import pytest
+
+from paddle_tpu.analysis.runtime import (
+    SourceIndex, run_rules, run_runtime, load_waivers, WaiverError,
+    registered_runtime_rules, default_runtime_rules)
+from paddle_tpu.analysis.runtime.rules.locks import LockDisciplineRule
+from paddle_tpu.analysis.runtime.rules.verbs import VerbConformanceRule
+from paddle_tpu.analysis.runtime.rules.catalog import (
+    CatalogConsistencyRule)
+from paddle_tpu.analysis.runtime.rules.shared_state import (
+    ThreadSharedStateRule)
+from paddle_tpu.analysis.__main__ import main as analysis_main
+
+
+def _lint(sources, rule, texts=None, waivers=None):
+    index = SourceIndex.from_sources(sources, texts=texts)
+    return run_rules(index, rules=[rule()], waivers=waivers)
+
+
+def _hits(report, needle, severity=None):
+    return [f for f in report.findings
+            if needle in f.message
+            and (severity is None or f.severity == severity)]
+
+
+# ------------------------------------------------------ RT01 fixtures
+DEADLOCK_CYCLE = '''\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                self.n = 1
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                self.n = 2
+'''
+
+RECV_UNDER_LOCK = '''\
+import threading
+
+class Conn:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+
+    def pull(self):
+        with self._lock:
+            data = self._sock.recv(4096)
+        return data
+'''
+
+
+def test_lock_rule_flags_seeded_deadlock_cycle():
+    rep = _lint({"paddle_tpu/toy/pair.py": DEADLOCK_CYCLE},
+                LockDisciplineRule)
+    hits = _hits(rep, "lock-order cycle: _a -> _b -> _a", "error")
+    assert len(hits) == 1, rep.render_text()
+    f = hits[0]
+    assert f.rule == "lock-discipline"
+    assert f.file == "paddle_tpu/toy/pair.py"
+    assert f.line == 10          # the inner `with self._b:` in fwd()
+    assert f.where == "Pair"
+    # the cycle is the only finding — no blocking-call noise
+    assert len(rep.findings) == 1
+
+
+def test_lock_rule_flags_socket_recv_under_held_lock():
+    rep = _lint({"paddle_tpu/toy/conn.py": RECV_UNDER_LOCK},
+                LockDisciplineRule)
+    assert len(rep.findings) == 1, rep.render_text()
+    f = rep.findings[0]
+    assert f.severity == "error"
+    assert f.message == ("blocking call socket .recv() while holding "
+                         "lock '_lock'")
+    assert (f.file, f.line) == ("paddle_tpu/toy/conn.py", 10)
+    assert f.where == "Conn.pull"
+
+
+def test_lock_rule_condition_wait_is_not_blocking():
+    # cv.wait() on the held condition RELEASES the lock — the correct
+    # pattern must stay clean.
+    src = '''\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def get(self):
+        with self._cv:
+            self._cv.wait()
+'''
+    rep = _lint({"paddle_tpu/toy/q.py": src}, LockDisciplineRule)
+    assert rep.findings == [], rep.render_text()
+
+
+# ------------------------------------------------------ RT02 fixtures
+TOY_FAULTS = '_DEFAULT_OPS = frozenset({"PUT", "GET"})\n'
+TOY_RETRY = 'VERB_CLASSES = {"PUT": "idempotent", "GET": "idempotent"}\n'
+TOY_DISPATCH = '''\
+def serve(sock):
+    op, name, payload, tctx = _recv_msg(sock, want_ctx=True)
+    if op == "PUT":
+        return 1
+    elif op == "GET":
+        return 2
+    elif op == "ZAP":
+        return 3
+'''
+
+
+def test_verb_rule_flags_unregistered_dispatch_verb():
+    rep = _lint({"paddle_tpu/resilience/faults.py": TOY_FAULTS,
+                 "paddle_tpu/resilience/retry.py": TOY_RETRY,
+                 "paddle_tpu/distributed/toy.py": TOY_DISPATCH},
+                VerbConformanceRule)
+    missing_class = _hits(rep, "dispatch verb 'ZAP' has no retry "
+                               "idempotence class", "error")
+    missing_ops = _hits(rep, "dispatch verb 'ZAP' missing from "
+                             "resilience/faults._DEFAULT_OPS", "error")
+    assert len(missing_class) == 1 and len(missing_ops) == 1, \
+        rep.render_text()
+    assert missing_ops[0].file == "paddle_tpu/distributed/toy.py"
+    assert missing_ops[0].line == 7          # the op == "ZAP" line
+    assert missing_ops[0].where == "serve"
+    # PUT/GET are covered; want_ctx=True makes the loop trace-aware
+    assert rep.findings == missing_class + missing_ops or \
+        len(rep.findings) == 2
+
+
+def test_verb_rule_flags_stale_table_entry():
+    faults = '_DEFAULT_OPS = frozenset({"PUT", "GET", "OLDV"})\n'
+    retry = ('VERB_CLASSES = {"PUT": "idempotent", '
+             '"GET": "idempotent", "OLDV": "idempotent"}\n')
+    dispatch = TOY_DISPATCH.replace('elif op == "ZAP":\n        '
+                                    'return 3\n', '')
+    rep = _lint({"paddle_tpu/resilience/faults.py": faults,
+                 "paddle_tpu/resilience/retry.py": retry,
+                 "paddle_tpu/distributed/toy.py": dispatch},
+                VerbConformanceRule)
+    stale = _hits(rep, "verb 'OLDV'", "warning")
+    assert len(stale) == 2, rep.render_text()   # both tables flagged
+    assert {f.file for f in stale} == {"paddle_tpu/resilience/faults.py",
+                                       "paddle_tpu/resilience/retry.py"}
+
+
+def test_verb_rule_warns_on_trace_blind_dispatcher():
+    blind = TOY_DISPATCH.replace(", want_ctx=True", "")
+    rep = _lint({"paddle_tpu/resilience/faults.py": TOY_FAULTS,
+                 "paddle_tpu/resilience/retry.py": TOY_RETRY,
+                 "paddle_tpu/distributed/toy.py": blind},
+                VerbConformanceRule)
+    warn = _hits(rep, "not reachable by the trace header path",
+                 "warning")
+    assert len(warn) == 1, rep.render_text()
+    assert warn[0].where == "serve"
+
+
+# ------------------------------------------------------ RT03 fixtures
+KIND_MISMATCH = '''\
+REG.counter("ptpu_toy_total", "help text")
+
+
+def scrape():
+    REG.gauge("ptpu_toy_total", "help text")
+'''
+
+
+def test_catalog_rule_flags_kind_mismatched_metric():
+    rep = _lint({"paddle_tpu/monitor/toy.py": KIND_MISMATCH},
+                CatalogConsistencyRule)
+    assert len(rep.findings) == 1, rep.render_text()
+    f = rep.findings[0]
+    assert f.severity == "error"
+    assert f.message == ("metric 'ptpu_toy_total' registered with "
+                         "mismatched kinds: counter/gauge")
+    assert f.line == 5        # anchored at the SECOND registration
+    assert "first registration" in f.hint
+
+
+def test_catalog_rule_flags_readme_ghost_metric():
+    rep = _lint({"paddle_tpu/monitor/toy.py":
+                 'REG.counter("ptpu_real_total", "h")\n'},
+                CatalogConsistencyRule,
+                texts={"README.md":
+                       "| `ptpu_ghost_total` | a metric |\n"
+                       "| `ptpu_real_total` | fine |\n"})
+    ghost = _hits(rep, "metric 'ptpu_ghost_total'", "error")
+    assert len(ghost) == 1, rep.render_text()
+    assert ghost[0].file == "README.md" and ghost[0].line == 1
+
+
+def test_catalog_rule_flags_unregistered_code_reference():
+    rep = _lint({"paddle_tpu/monitor/toy.py":
+                 'REG.counter("ptpu_real_total", "h")\n'
+                 'x = fetch("ptpu_phantom_total")\n'},
+                CatalogConsistencyRule)
+    assert len(rep.findings) == 1, rep.render_text()
+    assert "metric 'ptpu_phantom_total' referenced but never " \
+           "registered" in rep.findings[0].message
+
+
+def test_catalog_rule_brace_expansion_and_prom_suffixes():
+    # ptpu_fleet_{a,b}_total documents TWO metrics; _bucket resolves
+    # to its histogram; a trailing {label} group is stripped.
+    srcs = {"paddle_tpu/monitor/toy.py":
+            'REG.counter("ptpu_fleet_a_total", "h")\n'
+            'REG.counter("ptpu_fleet_b_total", "h")\n'
+            'REG.histogram("ptpu_lat_ms", "h")\n'}
+    readme = ("`ptpu_fleet_{a,b}_total` and `ptpu_lat_ms_bucket` and\n"
+              "`ptpu_fleet_a_total{shard,kind}` labels\n")
+    rep = _lint(srcs, CatalogConsistencyRule,
+                texts={"README.md": readme})
+    assert rep.findings == [], rep.render_text()
+
+
+# ------------------------------------------------------ RT04 fixture
+SHARED_STATE = '''\
+import threading
+
+class Worker:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._n = 0
+
+    def _run(self):
+        self._n = 1
+
+    def bump(self):
+        self._n += 1
+'''
+
+
+def test_shared_state_rule_is_info_only():
+    rep = _lint({"paddle_tpu/toy/worker.py": SHARED_STATE},
+                ThreadSharedStateRule)
+    assert len(rep.findings) == 1, rep.render_text()
+    f = rep.findings[0]
+    assert f.severity == "info"       # heuristic: must never gate
+    assert "attribute 'self._n' of thread-spawning class 'Worker'" \
+        in f.message
+    assert f.where == "Worker._run"
+    assert "bump" in f.hint
+    assert rep.at_least("warning") == []
+
+
+# ------------------------------------------------------ waivers
+def _blocking_index():
+    return {"paddle_tpu/toy/conn.py": RECV_UNDER_LOCK}
+
+
+def test_waiver_match_moves_finding_out_of_the_gate():
+    waivers = [{"rule": "lock-discipline",
+                "file": "paddle_tpu/toy/conn.py", "line": 10,
+                "reason": "single-socket stream serialization"}]
+    rep = _lint(_blocking_index(), LockDisciplineRule, waivers=waivers)
+    assert rep.findings == [], rep.render_text()
+    assert len(rep.waived) == 1
+    assert rep.waived[0].waived == "single-socket stream serialization"
+    assert rep.at_least("error") == []
+    assert "1 waived" in rep.render_text()
+
+
+def test_stale_waiver_fails_loudly():
+    waivers = [{"rule": "lock-discipline",
+                "file": "paddle_tpu/gone.py", "line": 3,
+                "reason": "anchored to a deleted file"}]
+    rep = _lint(_blocking_index(), LockDisciplineRule, waivers=waivers)
+    stale = _hits(rep, "stale waiver", "error")
+    assert len(stale) == 1 and stale[0].rule == "waivers"
+    # the real finding is NOT suppressed by a stale entry
+    assert _hits(rep, "blocking call", "error")
+
+
+def test_unmatched_waiver_fails_loudly():
+    waivers = [{"rule": "lock-discipline",
+                "file": "paddle_tpu/toy/conn.py", "line": 3,
+                "reason": "nothing fires here any more"}]
+    rep = _lint(_blocking_index(), LockDisciplineRule, waivers=waivers)
+    unmatched = _hits(rep, "unmatched waiver", "error")
+    assert len(unmatched) == 1 and unmatched[0].rule == "waivers"
+
+
+def test_malformed_waiver_file_raises(tmp_path):
+    p = tmp_path / "w.json"
+    p.write_text('{"waivers": [{"rule": "x"}]}')
+    with pytest.raises(WaiverError):
+        load_waivers(str(p))
+    p.write_text('{"waivers": [{"rule": "x", "file": "f", "line": 1, '
+                 '"reason": "   "}]}')      # blank reason is no waiver
+    with pytest.raises(WaiverError):
+        load_waivers(str(p))
+    p.write_text("not json")
+    with pytest.raises(WaiverError):
+        load_waivers(str(p))
+
+
+def test_checked_in_waiver_file_parses_with_reasons():
+    from paddle_tpu.analysis.runtime import default_waivers_path
+    entries = load_waivers(default_waivers_path())
+    assert entries, "waiver file should exist and be non-empty"
+    for ent in entries:
+        assert ent["reason"].strip()
+        assert ent["rule"] in registered_runtime_rules() or \
+            ent["rule"] == "waivers"
+
+
+# ------------------------------------------------------ engine/report
+def test_severity_ordering_and_json_shape():
+    rep = _lint({"paddle_tpu/toy/worker.py": SHARED_STATE,
+                 "paddle_tpu/toy/conn.py": RECV_UNDER_LOCK},
+                LockDisciplineRule)
+    rep2 = _lint({"paddle_tpu/toy/worker.py": SHARED_STATE},
+                 ThreadSharedStateRule)
+    rep.findings.extend(rep2.findings)
+    # at_least semantics: error floor excludes infos
+    assert all(f.severity == "error"
+               for f in rep.at_least("error"))
+    assert len(rep.at_least("info")) == len(rep.findings)
+    data = json.loads(rep.to_json())
+    assert set(data) == {"counts", "findings", "waived"}
+    assert set(data["counts"]) == {"error", "warning", "info"}
+    for f in data["findings"]:
+        assert {"rule", "severity", "file", "line",
+                "message"} <= set(f)
+
+
+def test_all_four_rules_registered_and_default():
+    names = {cls.name for cls in
+             (r.__class__ for r in default_runtime_rules())}
+    assert names == {"lock-discipline", "verb-conformance",
+                     "catalog-consistency", "thread-shared-state"}
+    ids = sorted(c.id for c in registered_runtime_rules().values())
+    assert ids == ["RT01", "RT02", "RT03", "RT04"]
+
+
+# ------------------------------------------------------ verb tables
+def test_default_ops_covers_clock_and_telemetry_verbs():
+    """PR-16 drift fix: CLKS/METR/HLTH are served by every telemetry
+    dispatcher but were absent from the fault-injection table."""
+    from paddle_tpu.resilience.faults import _DEFAULT_OPS
+    assert {"CLKS", "METR", "HLTH"} <= set(_DEFAULT_OPS)
+
+
+def test_verb_classes_total_over_default_ops():
+    """Every faultable verb carries a machine-readable retry class and
+    only admin verbs may skip the fault table."""
+    from paddle_tpu.resilience.faults import _DEFAULT_OPS
+    from paddle_tpu.resilience.retry import VERB_CLASSES
+    assert set(_DEFAULT_OPS) <= set(VERB_CLASSES)
+    assert set(VERB_CLASSES.values()) <= {
+        "idempotent", "round_tag", "nonretryable", "admin"}
+    extra = set(VERB_CLASSES) - set(_DEFAULT_OPS)
+    assert all(VERB_CLASSES[v] == "admin" for v in extra), extra
+
+
+# ------------------------------------------------------ tier-1 gate
+def test_runtime_gate_is_clean_at_head():
+    """THE gate: the whole-repo runtime lint must hold at HEAD with
+    nothing at warning level or above surviving the waiver file —
+    equivalent to ``python -m paddle_tpu.analysis --runtime`` exit 0."""
+    report = run_runtime()
+    assert report.at_least("warning") == [], "\n" + report.render_text()
+
+
+def test_cli_runtime_json_exit_zero(capsys):
+    rc = analysis_main(["--runtime", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    data = json.loads(out)
+    assert data["counts"]["error"] == 0
+    assert data["counts"]["warning"] == 0
+
+
+def test_cli_runtime_list_rules(capsys):
+    rc = analysis_main(["--runtime", "--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rid in ("RT01", "RT02", "RT03", "RT04"):
+        assert rid in out
+
+
+def test_cli_runtime_unknown_rule_exits_2():
+    with pytest.raises(SystemExit) as e:
+        analysis_main(["--runtime", "--rules", "no-such-rule"])
+    assert e.value.code == 2
+
+
+def test_cli_runtime_malformed_waivers_exit_2(tmp_path, capsys):
+    p = tmp_path / "w.json"
+    p.write_text('{"waivers": "nope"}')
+    with pytest.raises(SystemExit) as e:
+        analysis_main(["--runtime", "--waivers", str(p)])
+    assert e.value.code == 2
+
+
+def test_import_check_covers_runtime_packages():
+    from paddle_tpu.analysis.__main__ import IMPORT_CHECK_PACKAGES
+    assert "paddle_tpu.analysis.runtime" in IMPORT_CHECK_PACKAGES
+    assert "paddle_tpu.analysis.runtime.rules" in IMPORT_CHECK_PACKAGES
