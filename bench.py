@@ -944,6 +944,120 @@ def main():
 
     alerts_summary = guarded("alerts-probe", alerts_probe, errors)
 
+    def forensics_probe():
+        """ISSUE-17 incident-forensics probe, CPU-pinned like the
+        fleet probe: (a) DISARMED overhead of the tail span ring — the
+        same mixed request set through a 3-replica fleet with tracing
+        at 1/64 head sampling, interleaved A/B windows with the ring ON
+        (the new default) vs OFF (``tail_window=0``, the historical
+        behavior); (b) the ARMED path — wall clock of one full fleet
+        DUMP capture (lease-discovered KV + 3 replicas assembled into a
+        CRC-manifested bundle) plus the bundle's verify verdict."""
+        import shutil
+        import tempfile
+
+        import jax
+        import numpy as np
+        from paddle_tpu import trace
+        from paddle_tpu.distributed.membership import KVServer, KVClient
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.models.transformer_infer import TransformerLMInfer
+        from paddle_tpu.monitor import forensics as fx
+        from paddle_tpu.serving import fleet
+        prev = jax.config.jax_default_device
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        tdir = tempfile.mkdtemp(prefix="ptpu-bench-fx-")
+        try:
+            _fresh()
+            scope = fluid.global_scope()
+            # decode-bound shape (fleet-probe rationale): the ring's
+            # per-span cost must be measured against real decode work,
+            # not a dispatch-bound toy
+            T.transformer_lm(vocab_size=256, max_len=160, n_layer=2,
+                             n_head=4, d_model=256, d_inner=1024)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            lm = TransformerLMInfer(fluid.default_main_program(), scope,
+                                    2, 4, 256, 160)
+            rng = np.random.RandomState(0)
+            prompts, news = [], []
+            for _ in range(12):
+                plen = int(rng.randint(1, 9))
+                prompts.append([1] + rng.randint(3, 256,
+                                                 plen - 1).tolist())
+                news.append(int(rng.randint(32, 65)))
+            kvs = KVServer(sweep_interval=0.05).start()
+            kv = KVClient(kvs.endpoint)
+            cells = [fleet.Replica(kv, lm, desired=3, slots=2,
+                                   prefill_chunk=8, ttl=0.5)
+                     for _ in range(3)]
+            router = fleet.Router(kvs.endpoint, window=4,
+                                  refresh_interval=0.05)
+            router.wait_for_replicas(3)
+
+            def win(tail_window, tag):
+                trace.enable(
+                    log_path=os.path.join(
+                        tdir, "spans-%s.jsonl" % tag),
+                    sample_rate=1.0 / 64, tail_window=tail_window)
+                t0 = time.perf_counter()
+                out = router.generate_many(prompts, news, timeout=120)
+                dt = time.perf_counter() - t0
+                trace.disable()
+                return sum(len(t) for t, _ in out) / dt
+
+            win(256, "w1"), win(0, "w2")      # warm every compile
+            a_tps, b_tps = [], []
+            for w in range(3):                # interleaved A/B
+                a_tps.append(win(256, "on%d" % w))
+                b_tps.append(win(0, "off%d" % w))
+            ma, spa, _ = agg(a_tps, nd=1)
+            mb, spb, _ = agg(b_tps, nd=1)
+
+            # armed pass: populate the rings, then time one full
+            # lease-discovered fleet capture
+            trace.enable(log_path=os.path.join(tdir, "spans-arm.jsonl"),
+                         sample_rate=1.0 / 64, tail_window=256)
+            router.generate_many(prompts, news, timeout=120)
+            t0 = time.perf_counter()
+            bundle = fx.capture(kv_endpoint=kvs.endpoint,
+                                deadline_s=2.0, out_dir=tdir)
+            cap_ms = 1000 * (time.perf_counter() - t0)
+            man = fx.load_manifest(bundle)
+            probe = {
+                "config": "transformer_lm 2L/d256, 12 mixed reqs "
+                          "(32-64 new tokens), 3 replicas, sampling "
+                          "1/64 (CPU pin)",
+                "ring_on_tokens_per_s": round(ma, 1),
+                "ring_off_tokens_per_s": round(mb, 1),
+                "ring_on_spread_pct": spa,
+                "ring_off_spread_pct": spb,
+                "ring_overhead_pct": round(100 * (mb - ma) / mb, 2),
+                "capture_ms": round(cap_ms, 1),
+                "bundle_parts": len(man["parts"]),
+                "bundle_missing": len(man["missing"]),
+                "bundle_crc_ok": fx.verify(bundle) == [],
+            }
+            trace.disable()
+            router.close()
+            for c in cells:
+                try:
+                    c.shutdown()
+                except Exception:
+                    pass
+            kv.shutdown_server()
+            kv.close()
+            print("forensics probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            from paddle_tpu import trace as _trace
+            _trace.disable()
+            shutil.rmtree(tdir, ignore_errors=True)
+            jax.config.update("jax_default_device", prev)
+
+    forensics_summary = guarded("forensics-probe", forensics_probe,
+                                errors)
+
     ips, res_spread, res_samples = agg(res_s)
     large_flops_tok = flops_per_token(L=8, D=1024, FFN=4096, T=1024,
                                       V=8192)
@@ -1029,6 +1143,12 @@ def main():
         # over the clean interleaved window, and the scale hint the
         # direction-2 supervisor would have consumed
         out["alerts"] = alerts_summary
+    if forensics_summary is not None:
+        # incident-forensics stamp (ISSUE 17): tail span ring on/off
+        # interleaved A/B tokens/s through a 3-replica fleet (the
+        # disarmed-overhead contract) + one armed fleet DUMP capture's
+        # wall clock and the bundle's CRC verdict
+        out["forensics"] = forensics_summary
     if recsys_summary is not None:
         # sparse-serving stamp (ISSUE 12): cold-vs-warm hot-ID cache
         # scoring throughput A/B, final cache hit rate, measured

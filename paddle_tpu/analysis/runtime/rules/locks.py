@@ -45,6 +45,7 @@ KNOWN_BLOCKING = {
     "_clock_reply": "rpc reply _clock_reply()",
     "_metr_reply": "rpc reply _metr_reply()",
     "_hlth_reply": "rpc reply _hlth_reply()",
+    "_dump_reply": "rpc reply _dump_reply()",
     "_clock_exchange": "rpc _clock_exchange()",
     "create_connection": "socket.create_connection()",
 }

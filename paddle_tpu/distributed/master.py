@@ -22,7 +22,7 @@ import threading
 import time
 
 from .rpc import (_send_msg, _recv_msg, _clock_exchange, _clock_reply,
-                  _metr_reply, _hlth_reply)
+                  _metr_reply, _hlth_reply, _dump_reply)
 from ..monitor import metrics as _metrics
 from ..monitor import runtime as _mon
 from ..resilience import faults as _faults
@@ -226,6 +226,9 @@ class MasterServer:
             _metr_reply(sock, payload, role="master")
         elif op == "HLTH":
             _hlth_reply(sock, role="master")
+        elif op == "DUMP":
+            _dump_reply(sock, payload, role="master",
+                        state={"queue": self.queue.counts()})
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

@@ -22,7 +22,7 @@ import time
 import uuid
 
 from .rpc import (_send_msg, _recv_msg, _clock_reply, _metr_reply,
-                  _hlth_reply)
+                  _hlth_reply, _dump_reply)
 from ..monitor import metrics as _metrics
 from ..trace import clock as _clock
 from ..trace import runtime as _trace
@@ -212,6 +212,17 @@ class KVServer:
             _metr_reply(sock, payload, role="kv")
         elif op == "HLTH":
             _hlth_reply(sock, role="kv")
+        elif op == "DUMP":
+            # registry view, bounded: key -> value for live entries
+            # (the fleet roster an incident bundle pins down)
+            with self._lock:
+                now = time.time()
+                live = {k: v for k, (v, exp) in
+                        list(self._data.items())[:256]
+                        if exp is None or exp >= now}
+            _dump_reply(sock, payload, role="kv",
+                        state={"keys": len(self._data),
+                               "registry": live})
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

@@ -25,6 +25,11 @@ propagation and the retry policy apply to all of them for free):
                                           every dispatch loop plus
                                           monitor.collector's
                                           TelemetryServer)
+    all       DUMP                       (forensics black-box capture:
+                                          span ring + recorder tail +
+                                          metrics + flags + role state
+                                          in one reply — see
+                                          monitor/forensics.py)
 """
 
 import itertools
@@ -355,6 +360,53 @@ def _hlth_reply(sock, role="proc", registry=None):
         {"role": role, "pid": os.getpid(), "alive": True,
          "incarnation": reg.incarnation,
          "uptime_s": reg.uptime_s()}).encode())
+
+
+def _dump_reply(sock, payload, role="proc", registry=None, state=None):
+    """Serve one DUMP black-box capture (incident forensics): this
+    process's tail span ring (sampled-out spans included), flight-
+    recorder ring tail, metrics snapshot, non-default flags, and the
+    dispatcher's role-specific ``state`` summary — everything a
+    coordinator needs to explain an incident after the fact, in one
+    JSON frame. Every section is salvage-guarded: a capture must
+    degrade to a partial snapshot, never fail (or stall) the serving
+    loop. Shared by every dispatch loop, like _metr_reply."""
+    body = {}
+    if payload:
+        try:
+            body = json.loads(bytes(payload).decode())
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+    reg = registry if registry is not None else _metrics.registry()
+    out = {"role": role, "pid": os.getpid(), "t": time.time(),
+           "incarnation": reg.incarnation, "uptime_s": reg.uptime_s()}
+    if state is not None:
+        out["state"] = state
+    try:
+        out["snapshot"] = reg.snapshot()
+    except Exception:
+        pass
+    try:
+        from .. import flags as _flags_mod
+        out["flags"] = _flags_mod.overrides()
+    except Exception:
+        pass
+    try:
+        out["spans"] = _trace.tail_dump(
+            max_spans=int(body.get("spans_max", 4096)))
+    except Exception:
+        pass
+    rec = _mon.recorder() if registry is None else None
+    if rec is not None and body.get("events", True):
+        try:
+            _cur, rows, lost = rec.events_since(None)
+            limit = int(body.get("events_max", 1024))
+            out["events"] = rows[-limit:] if limit else rows
+            out["events_lost"] = lost
+            out["ring"] = rec.ring_id
+        except Exception:
+            pass
+    _send_msg(sock, "VAL", "", json.dumps(out).encode())
 
 
 def _parse_tag(tag):
@@ -700,6 +752,15 @@ class VariableServer:
             _metr_reply(sock, payload, role="pserver")
         elif op == "HLTH":
             _hlth_reply(sock, role="pserver")
+        elif op == "DUMP":
+            with self._lock:
+                state = {"round": self._round,
+                         "vars": len(self.store),
+                         "pending_grads": {k: len(v) for k, v
+                                           in self.grads.items()},
+                         "fan_in": self.fan_in, "sync": self.sync,
+                         "incarnation": self.incarnation}
+            _dump_reply(sock, payload, role="pserver", state=state)
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

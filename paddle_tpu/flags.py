@@ -51,6 +51,11 @@ Registered flags:
   signals_spec    str   default spec for python -m paddle_tpu.monitor
                         alerts (burn-rate objectives + sustained-rule
                         overrides; falls back to slo_spec)
+  trace_tail_*    —     tail-based trace retention (in-memory span
+                        ring trace window; slow-root promotion
+                        threshold in ms)
+  forensics_dir   str   incident-bundle output directory for
+                        monitor.forensics black-box DUMP captures
 
 Distributed bootstrap envs (read by distributed.launch, not here):
   PADDLE_COORDINATOR, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID.
@@ -167,6 +172,26 @@ _register("trace_clock_interval", float, 15.0,
           "(midpoint method over an idle RPC round trip; the merge CLI "
           "uses the min-RTT sample to skew-correct timestamps). <=0 "
           "probes at every opportunity")
+_register("trace_tail_window", int, 256,
+          "tail-based trace retention: number of recent traces the "
+          "always-on in-memory span ring buffers per process (ALL "
+          "spans, sampled-out ones included, grouped by trace id) so "
+          "a retention decision made AFTER a trace ends — root error, "
+          "root over trace_tail_slow_ms, or an incident naming the "
+          "trace — can still promote the whole trace to the span log. "
+          "0 disables the ring and restores pre-forensics behavior "
+          "(sampled-out spans emit headerless frames)")
+_register("trace_tail_slow_ms", float, 0.0,
+          "tail-retention slow threshold: a ROOT span whose duration "
+          "reaches this many milliseconds is retroactively promoted "
+          "to the span log with reason 'slow' (derive it from the SLO "
+          "latency objective). <=0 disables the slow rule; error and "
+          "incident-offender promotion stay on")
+_register("forensics_dir", str, "",
+          "directory monitor.forensics writes incident bundles into "
+          "(black-box DUMP captures assembled into a CRC-manifested "
+          "bundle when a signals incident OPENs). Empty = "
+          "forensics_bundles under the cwd")
 _register("rpc_retry", bool, True,
           "run idempotent RPC verbs (GET/PRFT/PUT, tagged SEND/BARR, "
           "master GETT/DONE/FAIL/PING) under the resilience retry "
@@ -378,6 +403,19 @@ def set_flag(name, value):
     f._override = value
     if name == "debug_nans":
         _apply_debug_nans()
+
+
+def overrides():
+    """{name: current value} of every flag whose value differs from
+    its default (env var or set_flag) — the active-configuration stamp
+    a forensics DUMP capture carries, so a bundle records how each
+    process was actually configured at the incident."""
+    out = {}
+    for f in _FLAGS.values():
+        v = f.value()
+        if v != f.default:
+            out[f.name] = v
+    return out
 
 
 def flags_help():

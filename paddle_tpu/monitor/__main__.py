@@ -17,6 +17,13 @@
     python -m paddle_tpu.monitor alerts --incident run.jsonl ...
         # timeline splicing alert rows with the goodput ledger's
         # badput intervals ("what happened at 14:32")
+    python -m paddle_tpu.monitor bundle <dir>
+        # incident forensics (monitor/forensics.py): verify a bundle's
+        # CRC manifest and render the skew-corrected cross-process
+        # timeline centered on the offender traces
+    python -m paddle_tpu.monitor bundle --capture --fleet <kv> <dir>
+        # on-demand black-box capture: DUMP every fleet process into a
+        # new bundle under <dir>, then render it
 
 The summary covers BOTH workloads a log may carry: training `step`
 rows (step count, latency percentiles, compile/recompile causes, MFU,
@@ -426,6 +433,59 @@ def _goodput_main(argv):
     return 0
 
 
+def _bundle_main(argv):
+    from . import forensics as fx
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.monitor bundle",
+        description="Incident forensics (monitor/forensics.py): "
+                    "verify a bundle's CRC manifest and render the "
+                    "one-screen incident summary + offender-centered "
+                    "cross-process timeline; --capture assembles a "
+                    "fresh bundle from a live fleet first")
+    p.add_argument("dir",
+                   help="bundle directory to render — with --capture, "
+                        "the base directory the new bundle is "
+                        "created under")
+    p.add_argument("--capture", action="store_true",
+                   help="fan DUMP out across the fleet (discovery "
+                        "via --fleet/--endpoint) and assemble a new "
+                        "bundle under <dir> before rendering it")
+    p.add_argument("--fleet", default=None, metavar="KV_ENDPOINT",
+                   help="membership KV registry (host:port) for "
+                        "--capture discovery")
+    p.add_argument("--endpoint", action="append", default=[],
+                   metavar="ROLE=HOST:PORT",
+                   help="extra static capture endpoint (repeatable — "
+                        "the master and KV server are not "
+                        "lease-registered)")
+    p.add_argument("--deadline", type=float, default=2.0,
+                   help="per-process DUMP deadline in seconds; a "
+                        "slower process is dropped and recorded as "
+                        "missing (default 2)")
+    args = p.parse_args(argv)
+    path = args.dir
+    if args.capture:
+        if args.fleet is None and not args.endpoint:
+            p.error("--capture needs --fleet and/or --endpoint")
+        static = []
+        for s in args.endpoint:
+            if "=" not in s:
+                print("bundle: --endpoint wants ROLE=HOST:PORT, got "
+                      "%r" % s, file=sys.stderr)
+                return 2
+            role, ep = s.split("=", 1)
+            static.append((role, ep))
+        path = fx.capture(kv_endpoint=args.fleet, static=static,
+                          deadline_s=args.deadline, out_dir=args.dir)
+    try:
+        return fx.render(path)
+    except (OSError, ValueError) as e:
+        # missing directory / unreadable or non-bundle manifest: a
+        # usage error on the analysis/slo convention
+        print("bundle: %s: %s" % (path, e), file=sys.stderr)
+        return 2
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     try:
@@ -448,6 +508,8 @@ def _main(argv):
         return _goodput_main(argv[1:])
     if argv and argv[0] == "alerts":
         return _alerts_main(argv[1:])
+    if argv and argv[0] == "bundle":
+        return _bundle_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.monitor",
         description="Summarize a paddle_tpu.monitor flight-recorder "

@@ -67,7 +67,7 @@ def _valid_endpoint(ep):
 
 
 class TelemetryServer:
-    """Scrape-only endpoint (METR / HLTH / CLKS / EXIT on the shared
+    """Scrape-only endpoint (METR / HLTH / DUMP / CLKS / EXIT on the shared
     frame protocol) for processes without a dispatch loop of their
     own. Serves the process-wide registry by default; tests may pin a
     private ``Registry`` (and swap it to model a restart)."""
@@ -78,7 +78,7 @@ class TelemetryServer:
         # distributed tier exists (paddle_tpu/__init__ import order)
         from ..distributed.rpc import (_recv_msg, _send_msg,
                                        _clock_reply, _metr_reply,
-                                       _hlth_reply)
+                                       _hlth_reply, _dump_reply)
         from ..trace import runtime as _trace
         self.role = role
         self.registry = registry         # None -> global at call time
@@ -90,6 +90,9 @@ class TelemetryServer:
                             registry=outer.registry)
             elif op == "HLTH":
                 _hlth_reply(request, role=outer.role,
+                            registry=outer.registry)
+            elif op == "DUMP":
+                _dump_reply(request, payload, role=outer.role,
                             registry=outer.registry)
             elif op == "CLKS":
                 _clock_reply(request)

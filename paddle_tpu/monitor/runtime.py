@@ -101,6 +101,11 @@ TRACE_SPANS = _REG.counter("ptpu_trace_spans_total",
 TRACE_DROPPED = _REG.counter(
     "ptpu_trace_dropped_total",
     "distributed-trace spans lost (span log capped or absent)")
+TRACE_RETAINED = _REG.counter(
+    "ptpu_trace_retained_total",
+    "traces retroactively promoted to the span log by tail-based "
+    "retention (root error / slow root / incident offender)",
+    ("reason",))
 # serving tier (paddle_tpu.serving): continuous-batching engine health.
 # Counters tick unconditionally (sub-microsecond next to a decode step);
 # the gauges make queue pressure and batch utilization scrapeable live

@@ -658,6 +658,11 @@ class Signals:
         self.rounds = 0
         self.transitions = []             # bounded history
         self.spec = spec
+        # forensics: called with each FIRING transition dict (monitor.
+        # forensics.attach installs the black-box capture coordinator
+        # here); exceptions are swallowed — detection must never die
+        # because a capture did
+        self.capture_hook = None
 
     # -- feeding -----------------------------------------------------------
     def _sw(self, name):
@@ -852,6 +857,22 @@ class Signals:
                 self._active[rule.name] = {
                     "severity": rule.severity, "since": now,
                     "value": value, "figures": figures}
+                # tail retention: the incident NAMES its offender
+                # traces — promote them now, before the span ring
+                # rotates past the onset (sampled-out spans included)
+                try:
+                    from ..trace import runtime as _trc
+                    for o in tr["offenders"]:
+                        if o.get("trace"):
+                            _trc.retain_trace(o["trace"], "offender")
+                except Exception:
+                    pass
+                hook = self.capture_hook
+                if hook is not None:
+                    try:
+                        hook(tr)
+                    except Exception:
+                        pass
             else:
                 self._active.pop(rule.name, None)
             transitions.append(tr)

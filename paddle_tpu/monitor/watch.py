@@ -295,14 +295,17 @@ def fleet_lines(fleet_snap, now=None):
 
 
 def render_frame(state, path, slo_verdict=None, now=None,
-                 staleness=None, fleet=None, alerts_line=None):
+                 staleness=None, fleet=None, alerts_line=None,
+                 incidents_line=None):
     """One frame of the dashboard as a string (the ``--once`` / test
     surface; the live loop wraps it in an ANSI clear). ``staleness``:
     {path: last row ts} for the multi-log per-file indicator;
     ``fleet``: a collector fleet snapshot for the scraped-dashboard
     header; ``alerts_line``: the signals evaluator's ACTIVE ALERTS
     summary (monitor/signals.py — file mode and --fleet render the
-    same line from the same evaluation shape)."""
+    same line from the same evaluation shape); ``incidents_line``:
+    the forensics incidents summary (active incident names + most
+    recent bundle path, monitor/forensics.py)."""
     lines = ["paddle_tpu monitor watch — %s   %d events (%s)"
              % (path, state.events, state.platform or "?")]
     if state.last_ts is not None and now is not None:
@@ -432,6 +435,8 @@ def render_frame(state, path, slo_verdict=None, now=None,
     lines.append(health)
     if alerts_line is not None:
         lines.append(alerts_line)
+    if incidents_line is not None:
+        lines.append(incidents_line)
     if slo_verdict is not None:
         status = " ".join(
             "%s %s%s" % ("PASS" if r["pass"] else "FAIL",
@@ -529,12 +534,15 @@ def watch(path, interval=2.0, window=256, once=False, out=None,
             else:
                 sig.feed_events([e for e, _ in events])
                 sig.evaluate(now=time.time())
+            from . import forensics as _forensics
             frame = render_frame(state, label, slo_verdict=verdict,
                                  now=None if once else time.time(),
                                  staleness=last_ts
                                  if len(paths) > 1 else None,
                                  alerts_line=_signals
-                                 .active_alerts_line(sig))
+                                 .active_alerts_line(sig),
+                                 incidents_line=_forensics
+                                 .incidents_line(sig))
             if once:
                 out.write(frame + "\n")
                 return frame
@@ -617,12 +625,15 @@ def watch_fleet(kv_endpoint=None, static=(), interval=2.0, window=256,
                 sig.feed_sample("goodput_fraction",
                                 led["goodput_fraction"])
             sig.evaluate()
+            from . import forensics as _forensics
             frame = render_frame(state, "fleet %s" % label,
                                  slo_verdict=verdict,
                                  now=None if once else time.time(),
                                  fleet=snap,
                                  alerts_line=_signals
-                                 .active_alerts_line(sig))
+                                 .active_alerts_line(sig),
+                                 incidents_line=_forensics
+                                 .incidents_line(sig))
             if once:
                 from .metrics import META_KEY
                 eps = (snap.get(META_KEY) or {}).get("endpoints") or []

@@ -67,7 +67,8 @@ _DEFAULT_OPS = frozenset({
     "GETT", "DONE", "FAIL", "PING",                      # master
     "CAS", "DEL", "CAD", "LIST", "LEAS",                 # kv store
     "SUBM", "POLL", "CANC", "STAT",                      # serving fleet
-    "CLKS", "METR", "HLTH",       # clock/telemetry (every dispatcher)
+    "CLKS", "METR", "HLTH", "DUMP",   # clock/telemetry/forensics
+                                      # (every dispatcher)
 })
 
 _SEND_KINDS = ("drop", "close_mid_frame", "duplicate", "delay")

@@ -65,8 +65,10 @@ VERB_CLASSES = {
     # journal, so EVERY verb is idempotent by construction
     "SUBM": "idempotent", "POLL": "idempotent", "CANC": "idempotent",
     "STAT": "idempotent",
-    # clock/telemetry reads served by every dispatcher + shutdown
+    # clock/telemetry/forensics reads served by every dispatcher +
+    # shutdown (DUMP is a read-only snapshot: safe to re-issue)
     "CLKS": "idempotent", "METR": "idempotent", "HLTH": "idempotent",
+    "DUMP": "idempotent",
     "EXIT": "admin",
 }
 

@@ -1118,8 +1118,13 @@ class Engine:
                 tokens=lat["tokens"],
                 prefill_chunks=lat["prefill_chunks"],
                 prompt_len=len(req.prompt),
+                # with the tail ring armed, unsampled traces are still
+                # buffered in memory — stamp the id so a later
+                # retention promotion can correlate this row to them
                 trace_id=(ctx.trace_id
-                          if ctx is not None and ctx.sampled else None),
+                          if ctx is not None
+                          and (ctx.sampled or _trc.tail_armed())
+                          else None),
                 error=None if error is None else repr(error))
             req._span.annotate(
                 **{k: v for k, v in lat.items() if v is not None})
@@ -1137,10 +1142,12 @@ class Engine:
         a request's lane to the engine iterations that drove it.
         Mirrors the sampled check _retire_telemetry does for the trace
         id: an UNSAMPLED step span is never written to the span log,
-        and a dangling join reference would be worse than none."""
+        and a dangling join reference would be worse than none — unless
+        the tail ring is armed, in which case the unsampled step span
+        IS buffered and a retention promotion can resolve the join."""
         cur = _trc.current_span()
         ctx = getattr(cur, "ctx", None)
-        if ctx is None or not ctx.sampled:
+        if ctx is None or not (ctx.sampled or _trc.tail_armed()):
             return None
         return ctx.span_id
 

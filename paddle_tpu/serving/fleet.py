@@ -90,7 +90,7 @@ import uuid
 from ..distributed import membership as _membership
 from ..distributed.membership import KVClient
 from ..distributed.rpc import (_send_msg, _recv_msg, _clock_reply,
-                               _metr_reply, _hlth_reply)
+                               _metr_reply, _hlth_reply, _dump_reply)
 from ..monitor import metrics as _metrics
 from ..monitor import runtime as _monrt
 from ..resilience import faults as _faults
@@ -395,6 +395,21 @@ class ReplicaServer:
             _metr_reply(sock, payload, role="replica")
         elif op == "HLTH":
             _hlth_reply(sock, role="replica")
+        elif op == "DUMP":
+            # black-box capture — also behind _maybe_fault: a wedged
+            # replica is dropped by the coordinator's deadline, which
+            # is itself forensic signal (the bundle records who failed
+            # to answer)
+            with self._lock:
+                inflight = sum(1 for j in self._jobs.values()
+                               if not j["req"].done())
+                unacked = len(self._jobs)
+            st = self.engine.stats
+            _dump_reply(sock, payload, role="replica", state={
+                "slot": self.slot, "inflight": inflight,
+                "unacked": unacked, "slots": self.engine.slots,
+                "steps": st["steps"], "tokens": st["tokens"],
+                "admissions": st["admissions"]})
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()

@@ -20,9 +20,22 @@ Design points:
     dispatch thread's reply sends must not re-inject the request's
     context back at the client.
   * Sampling is decided once at the ROOT (Dapper head sampling) and
-    inherited; unsampled spans still propagate locally (cheap) but are
-    neither recorded nor injected, so a disarmed-or-unsampled fleet
-    exchanges byte-identical old frames.
+    inherited; only sampled spans are PERSISTED at emission. A
+    disarmed fleet exchanges byte-identical old frames.
+  * Tail-based retention (the incident-forensics tier): an armed
+    tracer additionally buffers EVERY completed span — sampled-out
+    ones included, at full fidelity — in a bounded in-memory ring
+    grouped by trace id (``_TailRing``). The retention decision is
+    made AFTER the outcome is known: a root that closed with an
+    error, a root over ``trace_tail_slow_ms``, or a trace id named by
+    an open incident (``retain_trace``) promotes the WHOLE buffered
+    trace to the span log, so ``trace merge`` reconstructs exactly
+    the requests that went wrong without paying 100% sampling on
+    disk. With the ring armed (``trace_tail_window`` > 0, the
+    default) sampled-out spans DO inject their context block (wire
+    form already carries the sampled=0 flag) so a remote peer's ring
+    buffers the same trace under the same id; ``trace_tail_window=0``
+    restores the historical headerless behavior.
   * The span log reuses monitor's FlightRecorder (bounded JSONL,
     atomic-append, in-band truncation marker). Rows:
       span        {trace, span, parent, name, t0, dur, pid, proc, tid,
@@ -32,6 +45,7 @@ Design points:
       proc_meta   {argv}                   (lane naming)
 """
 
+import collections
 import os
 import random
 import sys
@@ -45,6 +59,7 @@ __all__ = [
     "SpanContext", "Span", "Tracer", "enable", "disable", "enabled",
     "tracer", "span", "annotate", "current_span", "active_trace_id",
     "extract", "maybe_enable_from_flags", "detached_span", "child_span",
+    "retain_trace", "tail_armed", "tail_dump",
 ]
 
 _DEFAULT_MAX_BYTES = 64 << 20
@@ -145,10 +160,10 @@ class Span:
                 stack.pop()
             elif self in stack:            # never corrupt the ambient
                 stack.remove(self)         # chain on exotic unwinds
-        if self.ctx.sampled:
-            if etype is not None:
-                self.attrs["error"] = repr(exc)
-            self._trc._record_span(self, dur)
+        if etype is not None:
+            self.attrs["error"] = repr(exc)
+        if self.ctx.sampled or self._trc._tail is not None:
+            self._trc._finish_span(self, dur)
         return False
 
 
@@ -176,16 +191,93 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+class _TailRing:
+    """Bounded in-memory buffer of COMPLETED spans grouped by trace id
+    — the tail-retention staging area and the spans part of a black-box
+    DUMP capture. LRU over traces (``window`` most recently touched
+    trace ids survive) with a per-trace span cap so one pathological
+    trace cannot evict the rest of the window."""
+
+    __slots__ = ("window", "span_cap", "_lock", "_traces")
+
+    def __init__(self, window, span_cap=512):
+        self.window = int(window)
+        self.span_cap = int(span_cap)
+        self._lock = threading.Lock()
+        self._traces = collections.OrderedDict()
+
+    def append(self, trace_id, row, sampled):
+        with self._lock:
+            e = self._traces.get(trace_id)
+            if e is None:
+                e = self._traces[trace_id] = {
+                    "rows": [], "sampled": bool(sampled), "dropped": 0}
+                while len(self._traces) > self.window:
+                    self._traces.popitem(last=False)
+            else:
+                self._traces.move_to_end(trace_id)
+                if sampled:
+                    e["sampled"] = True
+            if len(e["rows"]) >= self.span_cap:
+                e["dropped"] += 1
+            else:
+                e["rows"].append(row)
+
+    def pop(self, trace_id):
+        with self._lock:
+            return self._traces.pop(trace_id, None)
+
+    def snapshot(self):
+        """[(trace_id, {rows, sampled, dropped})] oldest-first; rows
+        lists are copied so the caller can serialize without racing
+        concurrent appends."""
+        with self._lock:
+            return [(tid, {"rows": list(e["rows"]),
+                           "sampled": e["sampled"],
+                           "dropped": e["dropped"]})
+                    for tid, e in self._traces.items()]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._traces)
+
+
+_RETAINED_CAP = 4096      # retained-trace ids remembered per process
+
+
 class Tracer:
     """Process-wide tracing state + span log writer."""
 
     def __init__(self, log_path=None, sample_rate=1.0, proc=None,
-                 clock_interval=15.0, max_bytes=_DEFAULT_MAX_BYTES):
+                 clock_interval=15.0, max_bytes=_DEFAULT_MAX_BYTES,
+                 tail_window=None, tail_slow_ms=None):
         self.proc = proc or _default_proc()
         self.pid = os.getpid()
         self.sample_rate = float(sample_rate)
         # <=0 means "every opportunity" (tests / short runs)
         self.clock_interval = float(clock_interval)
+        if tail_window is None or tail_slow_ms is None:
+            from .. import flags
+            try:
+                if tail_window is None:
+                    tail_window = flags.get_flag("trace_tail_window")
+                if tail_slow_ms is None:
+                    tail_slow_ms = flags.get_flag("trace_tail_slow_ms")
+            except KeyError:      # stripped-down flag registry (tests)
+                tail_window = tail_window or 0
+                tail_slow_ms = tail_slow_ms or 0.0
+        self.tail_slow_ms = float(tail_slow_ms)
+        self._tail = (_TailRing(int(tail_window))
+                      if int(tail_window) > 0 else None)
+        self._retained = set()          # trace ids already promoted
+        self._retained_order = collections.deque()
+        # rows of promoted traces, kept for DUMP captures: promotion
+        # pops the ring, but a forensics bundle assembled moments later
+        # (signals promotes offenders BEFORE the capture hook runs)
+        # must still see the offender's spans
+        self._promoted = collections.deque(maxlen=2048)
+        self._ports = []                # server_port rows (for DUMP)
+        self._clocks = collections.deque(maxlen=256)  # clock rows
         self._local = threading.local()
         self._lock = threading.Lock()
         self._clock_last = {}           # peer endpoint -> monotonic ts
@@ -209,13 +301,16 @@ class Tracer:
 
     def wire_context(self):
         """Bytes to inject into an outgoing frame, or None (no ambient
-        span / sampled out). Called from rpc._send_msg under the armed
-        branch only."""
+        span; or sampled out with the tail ring off). With the ring on,
+        sampled-out contexts DO propagate (the wire form carries the
+        sampled=0 flag) so the remote peer's ring buffers the trace
+        under the same id and tail retention can promote it fleet-wide.
+        Called from rpc._send_msg under the armed branch only."""
         s = getattr(self._local, "stack", None)
         if not s:
             return None
         ctx = s[-1].ctx
-        if not ctx.sampled:
+        if not ctx.sampled and self._tail is None:
             return None
         return ctx.to_wire()
 
@@ -241,29 +336,112 @@ class Tracer:
         return Span(self, ctx.child(), name, dict(attrs), ambient=False)
 
     # -- log rows ----------------------------------------------------------
-    def _record_span(self, span, dur):
-        rec = self._rec
+    def _finish_span(self, span, dur):
+        """A span closed: persist it (sampled / already-retained trace),
+        buffer it in the tail ring, and — when an UNSAMPLED root closes
+        — make the retention decision (error / slow) now that the
+        outcome is known."""
         row = {"trace": span.ctx.trace_id, "span": span.ctx.span_id,
                "parent": span.ctx.parent_id, "name": span.name,
                "t0": span.t0, "dur": dur, "pid": self.pid,
                "proc": self.proc, "tid": threading.get_ident()}
         if span.attrs:
             row["attrs"] = span.attrs
+        tid = span.ctx.trace_id
+        tail = self._tail
+        if span.ctx.sampled:
+            if tail is not None:
+                tail.append(tid, row, True)
+            self._write_row(row)
+            return
+        if tail is None:
+            return
+        with self._lock:
+            retained = tid in self._retained
+        if retained:
+            # trace was promoted while still open: late spans flow
+            # straight to the log instead of re-buffering
+            self._promoted.append(row)
+            self._write_row(row)
+            return
+        tail.append(tid, row, False)
+        if span.ctx.parent_id is None:
+            if "error" in span.attrs:
+                self.retain_trace(tid, "error")
+            elif (self.tail_slow_ms > 0
+                  and dur * 1000.0 >= self.tail_slow_ms):
+                self.retain_trace(tid, "slow")
+
+    def _write_row(self, row):
+        rec = self._rec
         if rec is not None and rec.record("span", **row):
             _mon.TRACE_SPANS.inc(proc=self.proc)
         else:
             _mon.TRACE_DROPPED.inc()
+
+    def retain_trace(self, trace_id, reason="incident"):
+        """Retroactively promote a buffered trace to the span log; the
+        tail-retention policy point (root error / slow root) and the
+        incident hook (signals names offender trace ids). Idempotent;
+        marks the id retained even when nothing is buffered yet so
+        spans that close AFTER the decision persist too. Returns True
+        when the promotion took effect."""
+        if not trace_id or self._tail is None:
+            return False
+        with self._lock:
+            if trace_id in self._retained:
+                return False
+            self._retained.add(trace_id)
+            self._retained_order.append(trace_id)
+            if len(self._retained_order) > _RETAINED_CAP:
+                self._retained.discard(self._retained_order.popleft())
+        entry = self._tail.pop(trace_id)
+        if entry is not None and entry["sampled"]:
+            return False      # head sampling already persisted it
+        if entry is not None:
+            for row in entry["rows"]:
+                self._promoted.append(row)
+                self._write_row(row)
+        _mon.TRACE_RETAINED.inc(reason=reason)
+        self.flush()
+        return True
+
+    def tail_dump(self, max_spans=4096):
+        """Merge-consumable snapshot of this process's black box:
+        'ev'-tagged rows (proc_meta / server_port / clock / span) in
+        exactly the span-log shape, so a forensics bundle part feeds
+        trace.merge.load_logs unchanged (every row carries the ``ts``
+        the tolerant JSONL reader requires — the recorder would have
+        stamped it). Most recent spans win when the ring holds more
+        than ``max_spans``."""
+        now = time.time()
+        out = [{"ev": "proc_meta", "pid": self.pid, "proc": self.proc,
+                "argv": sys.argv[:4], "ts": now}]
+        for row in list(self._ports):
+            out.append(dict(row, ev="server_port", ts=now))
+        for row in list(self._clocks):
+            out.append(dict(row, ev="clock", ts=now))
+        spans = list(self._promoted)   # promoted traces left the ring
+        if self._tail is not None:
+            for _tid, e in self._tail.snapshot():
+                spans.extend(e["rows"])
+        for row in spans[-int(max_spans):] if max_spans else spans:
+            out.append(dict(row, ev="span", ts=row.get("t0", now)))
+        return out
 
     def record_server_port(self, port, endpoint=None):
         """Servers register their listening port (and, when known, the
         full host:port endpoint) so the merge can map a client clock
         sample's peer endpoint to this process — the endpoint
         disambiguates equal ports on different hosts."""
+        row = {"port": int(port), "pid": self.pid,
+               "proc": self.proc}
+        if endpoint:
+            row["endpoint"] = endpoint
+        with self._lock:
+            self._ports.append(row)     # kept for DUMP captures
+            del self._ports[:-64]
         if self._rec is not None:
-            row = {"port": int(port), "pid": self.pid,
-                   "proc": self.proc}
-            if endpoint:
-                row["endpoint"] = endpoint
             self._rec.record("server_port", **row)
 
     def clock_due(self, peer):
@@ -278,9 +456,11 @@ class Tracer:
         return True
 
     def record_clock(self, peer, offset, rtt):
+        row = {"peer": peer, "offset": offset, "rtt": rtt,
+               "pid": self.pid, "proc": self.proc}
+        self._clocks.append(row)        # kept for DUMP captures
         if self._rec is not None:
-            self._rec.record("clock", peer=peer, offset=offset, rtt=rtt,
-                             pid=self.pid, proc=self.proc)
+            self._rec.record("clock", **row)
 
     def flush(self):
         if self._rec is not None:
@@ -304,14 +484,17 @@ _TRACER = None
 
 
 def enable(log_path=None, sample_rate=1.0, proc=None,
-           clock_interval=15.0, max_bytes=_DEFAULT_MAX_BYTES):
+           clock_interval=15.0, max_bytes=_DEFAULT_MAX_BYTES,
+           tail_window=None, tail_slow_ms=None):
     """Arm tracing process-wide; returns the Tracer. Re-arming replaces
-    (and closes) the previous tracer."""
+    (and closes) the previous tracer. ``tail_window``/``tail_slow_ms``
+    default to the like-named flags (None = read the flag)."""
     global _TRACER
     disable()
     _TRACER = Tracer(log_path=log_path, sample_rate=sample_rate,
                      proc=proc, clock_interval=clock_interval,
-                     max_bytes=max_bytes)
+                     max_bytes=max_bytes, tail_window=tail_window,
+                     tail_slow_ms=tail_slow_ms)
     return _TRACER
 
 
@@ -360,10 +543,12 @@ def child_span(name, parent, **attrs):
     another thread's stack, or on no stack at all) — the per-prefill-
     chunk and first-token spans under a serving request span. No-op
     when disarmed, when the parent is a no-op, or when the parent was
-    sampled out."""
+    sampled out with the tail ring off (an armed ring buffers
+    sampled-out children so retention can recover them)."""
     t = _TRACER
     ctx = getattr(parent, "ctx", None)
-    if t is None or ctx is None or not ctx.sampled:
+    if t is None or ctx is None or (not ctx.sampled
+                                    and t._tail is None):
         return _NULL_SPAN
     return Span(t, ctx.child(), name, dict(attrs), ambient=False)
 
@@ -385,16 +570,48 @@ def current_span():
 
 
 def active_trace_id():
-    """The sampled ambient trace id, or None — monitor stamps it onto
+    """The ambient trace id when the trace is reconstructable (sampled,
+    or buffered by the tail ring), or None — monitor stamps it onto
     flight-recorder rows so per-process telemetry joins the fleet
     timeline."""
     t = _TRACER
     if t is None:
         return None
     cur = t.current_span()
-    if cur is None or not cur.ctx.sampled:
+    if cur is None:
+        return None
+    if not cur.ctx.sampled and t._tail is None:
         return None
     return cur.ctx.trace_id
+
+
+def tail_armed():
+    """True when the armed tracer's tail ring buffers sampled-out spans
+    — call sites that stamp trace ids onto telemetry widen their
+    'reconstructable?' gate with this (a sampled-out trace id is still
+    worth stamping if retention can promote it)."""
+    t = _TRACER
+    return t is not None and t._tail is not None
+
+
+def retain_trace(trace_id, reason="incident"):
+    """Promote a buffered trace to the span log (tail retention) —
+    signals calls this with incident offender trace ids. No-op when
+    disarmed / ring off / already retained; never raises."""
+    t = _TRACER
+    if t is None:
+        return False
+    return t.retain_trace(trace_id, reason)
+
+
+def tail_dump(max_spans=4096):
+    """This process's black-box trace snapshot ('ev'-tagged rows for
+    trace.merge) — the spans part of a forensics DUMP reply. [] when
+    disarmed."""
+    t = _TRACER
+    if t is None:
+        return []
+    return t.tail_dump(max_spans=max_spans)
 
 
 def _parse_rate(raw):

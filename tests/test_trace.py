@@ -81,11 +81,25 @@ def test_wire_header_roundtrip_and_headerless(tmp_path):
         assert bytes(raw[:4]) == b"GET "
         rpc._recv_exact(b, 1)                       # drain the name
 
-        # sampled-out root -> headerless too (old peers stay safe at
-        # any sampling rate)
+        # sampled-out root with the tail ring armed (the default) ->
+        # the context block still travels, flagged sampled=0, so a
+        # downstream retention promotion can recover the whole trace
         trace.enable(log_path=str(tmp_path / "t2.jsonl"),
                      sample_rate=1e-12)
         with trace.span("root3"):
+            tid = trace.active_trace_id()
+            rpc._send_msg(a, "GET", "w")
+        op, name, payload, ctx = rpc._recv_msg(b, want_ctx=True)
+        assert (op, name) == ("GET", "w") and ctx is not None
+        sc = trace.extract(ctx)
+        assert sc is not None and sc.trace_id == tid and not sc.sampled
+
+        # sampled-out root with the ring OFF -> headerless, exactly
+        # the historical frames (old peers stay safe at any sampling
+        # rate when tail retention is disabled)
+        trace.enable(log_path=str(tmp_path / "t3.jsonl"),
+                     sample_rate=1e-12, tail_window=0)
+        with trace.span("root4"):
             rpc._send_msg(a, "GET", "w")
         raw = rpc._recv_exact(b, 12)
         assert bytes(raw[:4]) == b"GET "
