@@ -529,6 +529,192 @@ def main():
 
     fleet_summary = guarded("fleet-probe", fleet_probe, errors)
 
+    def autoscale_probe():
+        """ISSUE-18 elastic-fleet probe, CPU-pinned like the fleet
+        probe: (a) DISARMED autoscaler overhead — the same mixed
+        request set through a plain 2-replica fleet vs an
+        Autoscaler-managed fleet of identical shape (both cold-booted
+        from the SAME v1 artifact), interleaved A/B windows: the
+        control loop's tick must be invisible to the serving path;
+        (b) a v1 -> v2 rolling weight update under live traffic —
+        bursts keep flowing through the router while the controller
+        replaces replicas one at a time — stamping the shed count
+        (contract: 0), the roll wall clock, and the p95 TTFT
+        inflation during the roll vs a steady window (delta-histogram
+        over ptpu_serving_ttft_seconds)."""
+        import shutil
+        import tempfile
+        import jax
+        import numpy as np
+        from paddle_tpu import serving
+        from paddle_tpu.distributed.membership import KVServer, KVClient
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.monitor.metrics import bucket_percentile
+        from paddle_tpu.monitor.runtime import SERVING_TTFT
+        from paddle_tpu.serving import fleet
+        prev = jax.config.jax_default_device
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        art_root = None
+        auto = router_a = router_b = None
+        cells_a, kvss = [], []
+        try:
+            _fresh()
+            scope = fluid.global_scope()
+            _, logits = T.transformer_lm(vocab_size=64, max_len=96,
+                                         n_layer=2, n_head=2,
+                                         d_model=64, d_inner=128)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            main = fluid.default_main_program()
+            art_root = tempfile.mkdtemp(prefix="ptpu_autoscale_")
+            v1 = os.path.join(art_root, "v1")
+            v2 = os.path.join(art_root, "v2")
+            # same weights under two version labels: token identity
+            # across the roll IS the acceptance contract, so v2 must
+            # decode exactly like v1
+            serving.save_lm_artifact(v1, main, scope, [logits],
+                                     2, 2, 64, 96)
+            serving.save_lm_artifact(v2, main, scope, [logits],
+                                     2, 2, 64, 96)
+            rng = np.random.RandomState(0)
+            reqs = []
+            for _ in range(12):
+                plen = int(rng.randint(1, 9))
+                prompt = [1] + rng.randint(3, 64, plen - 1).tolist()
+                reqs.append((prompt, int(rng.randint(16, 33))))
+            prompts = [p for p, _ in reqs]
+            news = [m for _, m in reqs]
+
+            # fleet A: two plain replicas, no controller
+            kva = KVServer(sweep_interval=0.05).start()
+            kvss.append(kva)
+            kvc = KVClient(kva.endpoint)
+            cells_a = [fleet.Replica(kvc, v1, desired=2, slots=4,
+                                     prefill_chunk=8, ttl=0.5)
+                       for _ in range(2)]
+            router_a = fleet.Router(kva.endpoint, window=8,
+                                    refresh_interval=0.05)
+            router_a.wait_for_replicas(2)
+            # fleet B: the SAME shape under the autoscale control loop
+            kvb = KVServer(sweep_interval=0.05).start()
+            kvss.append(kvb)
+            auto = serving.Autoscaler(
+                kvb.endpoint, v1, desired=2, min_replicas=1,
+                max_replicas=4, slots=4, ttl=0.5, interval=0.05,
+                prefill_chunk=8).start()
+            auto.wait_steady(timeout=60)
+            router_b = fleet.Router(kvb.endpoint, window=8,
+                                    refresh_interval=0.05)
+            router_b.wait_for_replicas(2)
+
+            def win(router):
+                t0 = time.perf_counter()
+                handles = [router.submit(p, m)
+                           for p, m in zip(prompts, news)]
+                out = [h.result(timeout=120) for h in handles]
+                return time.perf_counter() - t0, out
+
+            win(router_a), win(router_b)      # warm every compile
+            wins, a_dt, b_dt = 3, [], []
+            base, identical = None, True
+            for _ in range(wins):             # interleaved A/B
+                dt, out = win(router_a)
+                a_dt.append(dt)
+                base = out
+                dt, out = win(router_b)
+                b_dt.append(dt)
+                identical = identical and all(
+                    bt == rt for (bt, _), (rt, _) in zip(base, out))
+            ma, spa, _ = agg(a_dt, nd=4)
+            mb, spb, _ = agg(b_dt, nd=4)
+
+            nb = len(SERVING_TTFT.buckets) + 1
+
+            def ttft_counts():
+                return {k: list(v["counts"])
+                        for k, v in SERVING_TTFT.snapshot().items()}
+
+            def ttft_p95(before, after):
+                # windowed delta-histogram p95, merged across every
+                # engine label (the roll's v2 engines included)
+                delta = [0] * nb
+                for k, counts in after.items():
+                    b4 = before.get(k, [0] * nb)
+                    for i in range(min(nb, len(counts))):
+                        delta[i] += counts[i] - b4[i]
+                if sum(delta) <= 0:
+                    return None
+                return bucket_percentile(SERVING_TTFT.buckets,
+                                         delta, 0.95)
+
+            snap0 = ttft_counts()
+            win(router_b)                     # steady TTFT window
+            steady_p95 = ttft_p95(snap0, ttft_counts())
+            shed0 = router_b.stats["shed"]
+            snap1 = ttft_counts()
+            t0 = time.perf_counter()
+            auto.roll(v2)
+            roll_identical, bursts = True, 0
+            while auto.roll_status() is not None and bursts < 40:
+                _, out = win(router_b)
+                bursts += 1
+                roll_identical = roll_identical and all(
+                    bt == rt for (bt, _), (rt, _) in zip(base, out))
+            info = auto.wait_roll(timeout=120)
+            roll_wall_s = time.perf_counter() - t0
+            roll_p95 = ttft_p95(snap1, ttft_counts())
+            st = auto.wait_steady(timeout=60)
+            probe = {
+                "config": "transformer_lm 2L/d64 T96 artifacts, "
+                          "12 mixed reqs (16-32 new), 2 replicas "
+                          "x slots=4 (CPU pin)",
+                "windows": wins,
+                "plain_s": round(ma, 4), "plain_spread_pct": spa,
+                "managed_s": round(mb, 4), "managed_spread_pct": spb,
+                "overhead_pct": round(100 * (mb - ma) / ma, 2),
+                "identical": bool(identical),
+                "roll_s": round(info.get("convergence_s")
+                                or roll_wall_s, 3),
+                "roll_bursts": bursts,
+                "roll_shed": router_b.stats["shed"] - shed0,
+                "roll_aborted": bool(info.get("aborted")),
+                "roll_identical": bool(roll_identical),
+                "roll_replaced": info.get("replaced"),
+                "final_version_mix": st["version_mix"],
+            }
+            if steady_p95 is not None:
+                probe["steady_ttft_p95_ms"] = round(
+                    1000 * steady_p95, 2)
+            if roll_p95 is not None:
+                probe["roll_ttft_p95_ms"] = round(1000 * roll_p95, 2)
+            if steady_p95 and roll_p95 is not None:
+                probe["roll_ttft_inflation_pct"] = round(
+                    100 * (roll_p95 - steady_p95) / steady_p95, 1)
+            print("autoscale probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            for r in (router_a, router_b):
+                if r is not None:
+                    r.close()
+            if auto is not None:
+                auto.close()
+            for c in cells_a:
+                try:
+                    c.shutdown()
+                except Exception:
+                    pass
+            for s in kvss:
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            if art_root is not None:
+                shutil.rmtree(art_root, ignore_errors=True)
+            jax.config.update("jax_default_device", prev)
+
+    autoscale_summary = guarded("autoscale-probe", autoscale_probe,
+                                errors)
+
     def recsys_probe():
         """ISSUE-12 sparse-serving probe, CPU-pinned like the serving
         probe: DeepFM scoring against live pserver row shards through
@@ -1136,6 +1322,13 @@ def main():
         # latency) + the armed kill pass's resubmission/exactly-once
         # verdict
         out["fleet"] = fleet_summary
+    if autoscale_summary is not None:
+        # elastic-fleet stamp (ISSUE 18): disarmed autoscaler overhead
+        # (plain vs managed fleet, interleaved A/B) + the
+        # roll-under-traffic pass — shed count (contract: 0), roll
+        # wall clock, p95 TTFT inflation during the roll, and the
+        # token-identity verdict across the v1 -> v2 weight update
+        out["autoscale"] = autoscale_summary
     if alerts_summary is not None:
         # signal-plane stamp (ISSUE 14): armed mini-fleet alerting
         # probe — detection latency in scrape rounds from injected

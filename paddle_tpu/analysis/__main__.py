@@ -35,6 +35,7 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.serving",
     "paddle_tpu.serving.engine",
     "paddle_tpu.serving.fleet",
+    "paddle_tpu.serving.autoscale",
     "paddle_tpu.serving.kvpool",
     "paddle_tpu.serving.sampling",
     "paddle_tpu.serving.spec",

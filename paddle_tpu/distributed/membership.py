@@ -30,7 +30,7 @@ from ..trace import runtime as _trace
 __all__ = ["KVServer", "KVClient", "register_endpoint",
            "wait_for_endpoints", "live_endpoints", "role_prefix",
            "register_pserver", "wait_for_pservers", "TrainerLease",
-           "EVICTED_PREFIX"]
+           "EVICTED_PREFIX", "DRAINING_PREFIX"]
 
 # Registry-level tombstone protocol: an evictor (serving.fleet's
 # Router) CASes a slot's endpoint to "evicted:<ep>" instead of
@@ -40,6 +40,15 @@ __all__ = ["KVServer", "KVClient", "register_endpoint",
 # fleet router, monitor.collector discovery) filter these values.
 # Lives here because every consumer of the registry shares it.
 EVICTED_PREFIX = "evicted:"
+
+# Drain mark: a GRACEFULLY retiring holder re-marks its own lease value
+# to "draining:<ep>" (serving.autoscale scale-down / rolling update).
+# Unlike EVICTED_PREFIX the lease stays ALIVE and heartbeating — the
+# router must keep polling the replica for in-flight results while
+# refusing to dispatch NEW work to it, and the collector keeps scraping
+# it so the drain is observable. Readers strip the prefix to recover
+# the endpoint.
+DRAINING_PREFIX = "draining:"
 
 _REG = _metrics.registry()
 _HEARTBEATS = _REG.counter("ptpu_lease_heartbeats_total",
@@ -363,6 +372,7 @@ class _Lease:
         self.key = key
         self.ttl = ttl
         self.value = value
+        self._next_value = None
         self.lost = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -375,18 +385,58 @@ class _Lease:
                 if self.kv.lease_keepalive(self.key, self.ttl,
                                            expect=self.value):
                     continue
+                # A mark() in flight? The KV may already hold the NEW
+                # value while self.value still reads the old one —
+                # adopt it and keep beating rather than declaring the
+                # lease usurped by our own transition.
+                nxt = self._next_value
+                if nxt is not None and self.kv.lease_keepalive(
+                        self.key, self.ttl, expect=nxt):
+                    self.value = nxt
+                    continue
                 # expired: try to reclaim our slot atomically
                 if self.kv.cas(self.key, None, self.value, ttl=self.ttl):
                     _LEASE_RECLAIMS.inc()
                     continue
                 cur = self.kv.get(self.key)
-                if cur == self.value:       # raced with our own reclaim
-                    continue
+                if cur == self.value or \
+                        (nxt is not None and cur == nxt):
+                    continue                # raced with our own write
                 self.lost = True            # someone else owns it now
                 _LEASE_LOST.inc()
                 return
             except (ConnectionError, OSError):
                 return
+
+    def mark(self, new_value):
+        """Transition the lease's registered VALUE in place (e.g. ep ->
+        'draining:'+ep) without surrendering the slot. CAS-guarded on
+        our current value so a usurper's registration is never
+        clobbered; returns True when the transition took. The heartbeat
+        thread races this — ``_next_value`` is published BEFORE the CAS
+        so a concurrent keepalive that sees the new value adopts it
+        instead of flagging the lease lost. No lock is held across the
+        KV calls (lock-discipline)."""
+        if self.lost:
+            return False
+        self._next_value = new_value
+        if self.kv.cas(self.key, self.value, new_value, ttl=self.ttl):
+            self.value = new_value
+            self._next_value = None
+            return True
+        # CAS lost: either the heartbeat already adopted new_value (the
+        # reclaim path wrote it), or a usurper owns the slot.
+        cur = None
+        try:
+            cur = self.kv.get(self.key)
+        except (ConnectionError, OSError):
+            pass
+        if cur == new_value:
+            self.value = new_value
+            self._next_value = None
+            return True
+        self._next_value = None
+        return False
 
     def revoke(self):
         """Stop heartbeating and release the key (graceful leave).

@@ -50,9 +50,14 @@ from .metrics import (META_KEY, bucket_percentile, merge_snapshots,
 
 __all__ = ["TelemetryServer", "TelemetryClient", "Collector",
            "render_prometheus_snapshot", "maybe_arm_from_flags",
-           "TELEMETRY_ROLE"]
+           "TELEMETRY_ROLE", "AUTOSCALER_ROLE"]
 
 TELEMETRY_ROLE = "telemetry"
+# the serving.autoscale control loop lease-registers under this role so
+# collectors scrape its fleet metrics (desired replicas, scale events,
+# rolls) without configuration — string lives here so the monitor tier
+# needs no import of the serving tier
+AUTOSCALER_ROLE = "autoscaler"
 
 
 def _valid_endpoint(ep):
@@ -283,7 +288,8 @@ class Collector:
     ``render_prometheus()`` the text exposition of the same."""
 
     def __init__(self, kv_endpoint=None, roles=("ps", "replica",
-                                                TELEMETRY_ROLE),
+                                                TELEMETRY_ROLE,
+                                                AUTOSCALER_ROLE),
                  static=(), timeout=2.0):
         self._kv_endpoint = kv_endpoint
         self._roles = tuple(roles)
@@ -355,8 +361,15 @@ class Collector:
                     # non-endpoint value a registry slot may carry
                     # (live_endpoints: readers filter) is skipped —
                     # one garbage value must not poison the scrape
-                    if ep.startswith(_membership.EVICTED_PREFIX) \
-                            or not _valid_endpoint(ep):
+                    if ep.startswith(_membership.EVICTED_PREFIX):
+                        continue
+                    if ep.startswith(_membership.DRAINING_PREFIX):
+                        # a gracefully draining replica is alive and
+                        # MUST stay scrapeable — the drain itself is
+                        # the telemetry story; strip the mark to
+                        # recover the endpoint
+                        ep = ep[len(_membership.DRAINING_PREFIX):]
+                    if not _valid_endpoint(ep):
                         continue
                     found.append((role, ep))
         return found

@@ -260,6 +260,30 @@ ALERT_TRANSITIONS = _REG.counter(
 ALERTS_ACTIVE = _REG.gauge(
     "ptpu_alerts_active",
     "alerts currently FIRING in this process's signals evaluator")
+# elastic fleet tier (serving.autoscale, ISSUE 18): the control loop's
+# desired count, scale events, graceful drains and rolling weight
+# updates. Counters tick unconditionally (scale events are rare);
+# convergence is a histogram so fleet merges stay bucket-wise
+FLEET_DESIRED = _REG.gauge(
+    "ptpu_fleet_desired_replicas",
+    "replica count the autoscale control loop is converging toward")
+FLEET_VERSION_REPLICAS = _REG.gauge(
+    "ptpu_fleet_version_replicas",
+    "live replicas per serving artifact version (the fleet's version "
+    "mix during a rolling update)", ("version",))
+FLEET_SCALE_EVENTS = _REG.counter(
+    "ptpu_fleet_scale_events_total",
+    "autoscale desired-count moves", ("direction", "reason"))
+FLEET_DRAINS = _REG.counter(
+    "ptpu_fleet_drains_total",
+    "graceful replica drains started by the control loop")
+FLEET_ROLLS = _REG.counter(
+    "ptpu_fleet_rolls_total",
+    "rolling weight updates completed (aborted rolls excluded)")
+FLEET_VERSION_CONVERGENCE = _REG.histogram(
+    "ptpu_fleet_version_convergence_seconds",
+    "rolling update start -> 100% of the fleet serving the new "
+    "artifact version")
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -1017,6 +1041,70 @@ def on_alert(rule, severity, state, value=None, figures=None,
         if tr is not None:
             row["trace"] = tr
         rec.record("alert", **row)
+        rec.flush()
+
+
+def on_scale_event(direction, desired, live, reason, detail=None,
+                   version_mix=None):
+    """One autoscale desired-count move (serving.autoscale control
+    loop). ``reason`` is a SHORT category tag ("pressure", "idle",
+    "roll", "manual") — it labels the counter, so cardinality must
+    stay bounded; the free-text hint rationale travels in ``detail``
+    on the recorder row only. ``version_mix`` ({version: replicas})
+    refreshes the per-version gauge, the fleet's version-mix story
+    `monitor watch` renders."""
+    FLEET_SCALE_EVENTS.inc(direction=direction, reason=reason)
+    FLEET_DESIRED.set(int(desired))
+    if version_mix:
+        for ver, n in version_mix.items():
+            FLEET_VERSION_REPLICAS.set(int(n), version=str(ver))
+    rec = _S.rec
+    if rec is not None:
+        row = {"direction": direction, "desired": int(desired),
+               "live": int(live), "reason": reason}
+        if detail is not None:
+            row["detail"] = detail
+        if version_mix:
+            row["version_mix"] = {str(k): int(v)
+                                  for k, v in version_mix.items()}
+        rec.record("scale_event", **row)
+        rec.flush()
+
+
+def on_drain(slot, endpoint, version=None):
+    """One graceful replica drain started (admissions closed; the
+    cell retires once its in-flight work delivers and acks)."""
+    FLEET_DRAINS.inc()
+    rec = _S.rec
+    if rec is not None:
+        rec.record("drain", slot=slot, endpoint=endpoint,
+                   version=version)
+
+
+def on_roll(from_version, to_version, convergence_s=None, replaced=0,
+            shed_during=0, aborted=False, reason=None):
+    """One rolling weight update finished — completed (the fleet
+    reached 100% ``to_version``; ``convergence_s`` observed into the
+    histogram the SLO's ``version_convergence_s`` objective reads) or
+    ABORTED (roll halted, surviving fleet intact; no convergence
+    observation — a half-roll's wall time is not a convergence).
+    ``shed_during`` is the router's shed delta across the roll — the
+    shed-during-roll error budget's sample."""
+    if not aborted:
+        FLEET_ROLLS.inc()
+        if convergence_s is not None:
+            FLEET_VERSION_CONVERGENCE.observe(float(convergence_s))
+    rec = _S.rec
+    if rec is not None:
+        row = {"from_version": from_version, "to_version": to_version,
+               "replaced": int(replaced),
+               "shed_during": int(shed_during),
+               "aborted": bool(aborted)}
+        if convergence_s is not None:
+            row["convergence_s"] = float(convergence_s)
+        if reason is not None:
+            row["reason"] = reason
+        rec.record("roll", **row)
         rec.flush()
 
 

@@ -663,6 +663,11 @@ class Signals:
         # here); exceptions are swallowed — detection must never die
         # because a capture did
         self.capture_hook = None
+        # autoscaling: called with the round's ScaleHint after every
+        # evaluate() (serving.autoscale installs its controller here —
+        # same discipline as capture_hook: exceptions are swallowed,
+        # detection must never die because a scaler did)
+        self.scale_hook = None
 
     # -- feeding -----------------------------------------------------------
     def _sw(self, name):
@@ -878,6 +883,12 @@ class Signals:
             transitions.append(tr)
         self._update_idle(now)
         self.rounds += 1
+        shook = self.scale_hook
+        if shook is not None:
+            try:
+                shook(self.scale_hint())
+            except Exception:
+                pass
         if transitions:
             from . import runtime as _rt
             for tr in transitions:

@@ -97,6 +97,10 @@ class WatchState:
         self.truncated = False
         self.platform = None
         self.last_ts = None
+        # elastic-fleet rows (serving.autoscale, ISSUE 18): the newest
+        # scale_event / roll row feed the dashboard's autoscale line
+        self.last_scale_event = None
+        self.last_roll = None
 
     def feed_line(self, line, source=""):
         e = self.parse_line(line)
@@ -151,6 +155,10 @@ class WatchState:
             self.truncated = True
         elif ev == "devices":
             self.platform = e.get("platform")
+        elif ev == "scale_event":
+            self.last_scale_event = e
+        elif ev == "roll":
+            self.last_roll = e
 
     def goodput_rollup(self):
         """Per-SOURCE rolling ledgers rolled up per process — NEVER a
@@ -227,10 +235,22 @@ def _fleet_counter(snap, name):
     return sum(ent.get("series", {}).values())
 
 
-def fleet_lines(fleet_snap, now=None):
+def _fleet_gauge_series(snap, name):
+    """Gauge series dict (label key -> value), or None when the metric
+    is ABSENT — same absent-vs-zero discipline as _fleet_counter (the
+    autoscale line only renders when an autoscaler actually exports)."""
+    ent = snap.get(name) or {}
+    if ent.get("kind") != "gauge":
+        return None
+    return ent.get("series") or {}
+
+
+def fleet_lines(fleet_snap, now=None, state=None):
     """Fleet header for the scraped dashboard: one line per endpoint
     (role, liveness, uptime, scrape staleness) plus the merged fleet
-    counters — the collector's exact-sum view."""
+    counters — the collector's exact-sum view. ``state`` (a WatchState,
+    optional) contributes the newest scale_event / roll recorder rows
+    to the autoscale line."""
     from .metrics import META_KEY
     meta = fleet_snap.get(META_KEY) or {}
     eps = meta.get("endpoints") or []
@@ -291,6 +311,47 @@ def fleet_lines(fleet_snap, now=None):
         lines.append(
             "  spec     drafted %d accepted %d (accept rate %s)   "
             "dispatches %d" % (spd, spa, rate, spn))
+    des = _fleet_gauge_series(fleet_snap, "ptpu_fleet_desired_replicas")
+    if des:
+        # elastic fleet present (serving.autoscale, ISSUE 18): live vs
+        # desired replica count, per-version mix (the roll's
+        # convergence renders as this mix shifting to one version),
+        # and the scale/drain/roll totals the collector merges
+        # incarnation-correctly like every other counter
+        desired = int(max(des.values()))
+        live_g = _fleet_gauge_series(fleet_snap,
+                                     "ptpu_fleet_replicas") or {}
+        live = "%d" % int(max(live_g.values())) if live_g else "?"
+        mix_g = _fleet_gauge_series(
+            fleet_snap, "ptpu_fleet_version_replicas") or {}
+        mix = " ".join("%s:%d" % (k, int(v))
+                       for k, v in sorted(mix_g.items())
+                       if int(v)) or "n/a"
+        scl = _fleet_counter(fleet_snap,
+                             "ptpu_fleet_scale_events_total") or 0
+        drn = _fleet_counter(fleet_snap, "ptpu_fleet_drains_total") or 0
+        rol = _fleet_counter(fleet_snap, "ptpu_fleet_rolls_total") or 0
+        line = ("  autoscale replicas %s/%d   versions %s   "
+                "scale events %d   drains %d   rolls %d"
+                % (live, desired, mix, scl, drn, rol))
+        last = getattr(state, "last_scale_event", None)
+        if last is not None:
+            line += "   last %s->%s (%s)" % (
+                last.get("direction", "?"), last.get("desired", "?"),
+                last.get("reason", "?"))
+        lines.append(line)
+        lr = getattr(state, "last_roll", None)
+        if lr is not None:
+            dt = lr.get("convergence_s")
+            lines.append(
+                "  roll     %s -> %s   %s   replaced %d   shed %d%s"
+                % (lr.get("from_version", "?"),
+                   lr.get("to_version", "?"),
+                   "ABORTED: %s" % lr.get("reason")
+                   if lr.get("aborted") else "converged",
+                   int(lr.get("replaced") or 0),
+                   int(lr.get("shed_during") or 0),
+                   "" if dt is None else "   %.1fs" % dt))
     return lines
 
 
@@ -312,7 +373,7 @@ def render_frame(state, path, slo_verdict=None, now=None,
         age = max(0.0, now - state.last_ts)
         lines[0] += "   last event %.1fs ago" % age
     if fleet is not None:
-        lines.extend(fleet_lines(fleet, now=now))
+        lines.extend(fleet_lines(fleet, now=now, state=state))
     if staleness:
         lines.extend(staleness_lines(staleness, now=now))
 

@@ -152,6 +152,19 @@ PROBES = (
           "lower", 50.0),
     Probe("specialize_zoo_fused", ("specialize", "zoo_fused_total"),
           "higher", 5.0),
+    # elastic-fleet probes (ISSUE 18): the autoscale control loop's
+    # serving-path overhead is pct points around zero -> absolute
+    # band like the router-overhead probes; the roll wall clock and
+    # the shed-during-roll count guard the rolling-update contract
+    # (shed band 0: ANY shed during a roll is a regression, not
+    # noise). Missing on pre-18 baselines -> skip
+    Probe("autoscale_overhead_pct",
+          ("autoscale", "overhead_pct"), "lower", 15.0,
+          band_abs=10.0),
+    Probe("autoscale_roll_s", ("autoscale", "roll_s"), "lower",
+          50.0),
+    Probe("autoscale_roll_shed", ("autoscale", "roll_shed"),
+          "lower", 0.0, band_abs=0.0),
 )
 
 

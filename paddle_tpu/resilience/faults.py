@@ -22,7 +22,13 @@ Spec keys (all optional)::
         "max": 25                 # total injection budget
       },
       "kill": [{"target": "pserver", "after": 6}],   # "master",
-                                        # "replica" / "replica:<slot>"
+                                        # "replica" / "replica:<slot>";
+                                        # "drain" (after = drains
+                                        # started) and "roll" (after =
+                                        # replicas replaced) crash the
+                                        # cell whose graceful drain is
+                                        # just beginning
+                                        # (serving.autoscale)
       "stall": [{"target": "replica:1", "after": 4, "seconds": 3.0}],
                                         # one-shot dispatch wedge
       "ckpt": {"nth": 3, "mode": "bitflip"},         # or "truncate"

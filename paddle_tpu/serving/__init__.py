@@ -57,6 +57,19 @@ counter-keyed-sampled tokens). Temperature-0 stays bitwise-identical
 to the non-speculative engine; drafting quality only moves the
 acceptance rate.
 
+Elastic fleet (ISSUE 18): ``serving.autoscale.Autoscaler`` closes the
+loop from the alerting plane's ``Signals.scale_hint()`` to the fleet —
+scale-up cold-boots replicas from a PR-15 inference artifact,
+scale-down gracefully drains the least-loaded replica (typed ``DRNG``
+admission NACKs the router re-dispatches penalty-free, lease re-marked
+``draining:<ep>``, in-flight results delivered AND acked before
+retire), and ``roll(artifact_v2)`` replaces replicas one at a time
+(boot v2 -> healthy STAT -> drain v1 -> retire) with exactly-once
+preserved across the roll and an abort path that halts the roll — not
+the fleet — if a v2 replica fails health. Chaos-gated by
+tests/test_autoscale.py: kills mid-drain and mid-roll under seeded
+frame faults must stay token-identical to sequential decode.
+
 Request-level observability (ISSUE 6): every ``Request`` handle
 carries its lifecycle attribution after retirement — ``queue_wait``,
 ``ttft``, ``tpot``, ``prefill_chunks``, ``latency()`` — mirrored into
@@ -70,7 +83,8 @@ renders them live.
 from .engine import (Engine, Request,  # noqa: F401
                      sequential_generate)
 from .fleet import (Overloaded, Replica, ReplicaClient,  # noqa: F401
-                    ReplicaServer, Router, Supervisor)
+                    ReplicaDraining, ReplicaServer, Router, Supervisor)
+from .autoscale import Autoscaler  # noqa: F401
 from .kvpool import (BlockPool, RadixCache,  # noqa: F401
                      bytes_per_block)
 from .sampling import SamplingParams  # noqa: F401
@@ -80,6 +94,7 @@ from .artifact import (engine_from_artifact,  # noqa: F401
 
 __all__ = ["Engine", "Request", "sequential_generate", "Router",
            "Replica", "ReplicaServer", "ReplicaClient", "Supervisor",
-           "Overloaded", "BlockPool", "RadixCache", "bytes_per_block",
-           "SamplingParams", "NgramDrafter", "engine_from_artifact",
+           "Overloaded", "ReplicaDraining", "Autoscaler", "BlockPool",
+           "RadixCache", "bytes_per_block", "SamplingParams",
+           "NgramDrafter", "engine_from_artifact",
            "model_from_artifact", "save_lm_artifact"]

@@ -98,9 +98,10 @@ from ..resilience.retry import Policy, RETRYABLE
 from ..trace import runtime as _trace
 from .engine import Engine, _flag
 
-__all__ = ["Overloaded", "ReplicaServer", "Replica", "ReplicaClient",
-           "Router", "FleetRequest", "Supervisor", "choose_replica",
-           "REPLICA_ROLE", "EVICTED_PREFIX"]
+__all__ = ["Overloaded", "ReplicaDraining", "ReplicaServer", "Replica",
+           "ReplicaClient", "Router", "FleetRequest", "Supervisor",
+           "choose_replica", "REPLICA_ROLE", "EVICTED_PREFIX",
+           "DRAINING_PREFIX"]
 
 REPLICA_ROLE = "replica"
 # Stall-evicted slots are TOMBSTONED (CAS endpoint -> marker) rather
@@ -113,6 +114,12 @@ REPLICA_ROLE = "replica"
 # monitor collector filters it during discovery), so it lives in
 # membership; re-exported here for the existing fleet API surface.
 EVICTED_PREFIX = _membership.EVICTED_PREFIX
+# Graceful-drain lease mark (ISSUE 18): the retiring holder re-marks
+# its OWN lease value to "draining:<ep>" — the lease stays alive, the
+# router keeps polling the endpoint for in-flight results but stops
+# dispatching new work there. Registry-level protocol like
+# EVICTED_PREFIX; lives in membership, re-exported here.
+DRAINING_PREFIX = _membership.DRAINING_PREFIX
 
 _REG = _metrics.registry()
 FLEET_REPLICAS = _REG.gauge(
@@ -160,6 +167,19 @@ class Overloaded(RuntimeError):
         self.bound = bound
 
 
+class ReplicaDraining(RuntimeError):
+    """Typed SUBM NACK ("DRNG" reply) from a gracefully draining
+    replica: admissions are closed while in-flight work retires and
+    POLL/CANC keep serving. NOT retryable wire-level (the replica is
+    healthy — retrying the same endpoint is pointless) and NOT a
+    request failure: the router requeues the request for another
+    replica without burning its attempt budget."""
+
+    def __init__(self, rid):
+        super().__init__("replica draining: %s not admitted" % rid)
+        self.rid = rid
+
+
 # -- replica side -----------------------------------------------------------
 
 class ReplicaServer:
@@ -182,8 +202,10 @@ class ReplicaServer:
         import socketserver
         self.engine = engine
         self.slot = slot
+        self.version = None        # serving artifact version (ISSUE 18)
         self._on_crash = on_crash
-        self._lock = threading.Lock()
+        self._draining = False     # drain state: NACK new SUBM, keep
+        self._lock = threading.Lock()  # POLL/CANC/STAT serving
         self._fin_cv = threading.Condition(self._lock)
         self._jobs = {}            # rid -> {"req": Request, "t0": ts}
         self._accepted = 0         # SUBMs admitted (fault thresholds)
@@ -237,6 +259,13 @@ class ReplicaServer:
         if self._thread.is_alive():
             self._server.shutdown()
         self._server.server_close()
+
+    def drain(self):
+        """Close admissions (new SUBM gets the typed DRNG NACK, which
+        the router re-dispatches elsewhere) while POLL/CANC/STAT keep
+        serving so in-flight work retires and is acked. One-way: a
+        draining server never re-admits — the cell retires next."""
+        self._draining = True
 
     # ------------------------------------------------------------------
     def _maybe_fault(self):
@@ -307,9 +336,16 @@ class ReplicaServer:
         if op == "SUBM":
             body = json.loads(bytes(payload).decode())
             bad = None
+            drng = False
             with self._lock:
                 self._prune_locked(time.time())
-                if name not in self._jobs:
+                if self._draining and name not in self._jobs:
+                    # drain NACK: no NEW admissions (a duplicate SUBM
+                    # for an already-journaled id still acks OK — the
+                    # dedup contract holds through the drain). Sent
+                    # below, after the lock (lock-discipline).
+                    drng = True
+                elif name not in self._jobs:
                     try:
                         if "features" in body:
                             # scoring payload (serving.sparse): the
@@ -347,6 +383,9 @@ class ReplicaServer:
                         self._jobs[name] = {"req": req,
                                             "t0": time.time()}
                         self._accepted += 1
+            if drng:
+                _send_msg(sock, "DRNG", name)
+                return
             if bad is not None:
                 _send_msg(sock, "BADR", name, bad)
                 return
@@ -384,7 +423,9 @@ class ReplicaServer:
                 "slot": self.slot, "inflight": inflight,
                 "unacked": unacked, "slots": self.engine.slots,
                 "steps": st["steps"], "tokens": st["tokens"],
-                "admissions": st["admissions"]}).encode())
+                "admissions": st["admissions"],
+                "version": self.version,
+                "draining": self._draining}).encode())
         elif op == "CLKS":
             _clock_reply(sock)
         elif op == "METR":
@@ -409,7 +450,9 @@ class ReplicaServer:
                 "slot": self.slot, "inflight": inflight,
                 "unacked": unacked, "slots": self.engine.slots,
                 "steps": st["steps"], "tokens": st["tokens"],
-                "admissions": st["admissions"]})
+                "admissions": st["admissions"],
+                "version": self.version,
+                "draining": self._draining})
         elif op == "EXIT":
             _send_msg(sock, "OK")
             self.stop()
@@ -427,8 +470,16 @@ class Replica:
 
     def __init__(self, kv, model, desired, slots=2, ttl=0.5,
                  role=REPLICA_ROLE, name=None, engine_factory=None,
-                 **engine_kwargs):
+                 version=None, **engine_kwargs):
         self.name = name or ("replica-" + uuid.uuid4().hex[:6])
+        # serving artifact version (ISSUE 18 rolling updates): explicit,
+        # or derived from the artifact directory name when cold-booting
+        # from a PR-15 inference artifact — stamped into STAT/DUMP and
+        # the fleet's version-mix telemetry
+        if version is None and isinstance(model, str):
+            import os
+            version = os.path.basename(os.path.normpath(model))
+        self.version = version
         if engine_factory is not None:
             # non-decode cells (serving.sparse ScoringEngine): the
             # factory builds anything speaking the Engine protocol
@@ -454,7 +505,22 @@ class Replica:
             self.engine.close()
             raise
         self.server.slot = self.slot
+        self.server.version = self.version
         self.server.start()
+
+    def drain(self):
+        """Begin a graceful drain: close admissions on the server (new
+        SUBM → typed DRNG NACK the router re-dispatches) and re-mark
+        the lease value to ``draining:<ep>`` so every registry reader
+        sees the state. The lease keeps beating — the router must keep
+        polling for in-flight results until they are delivered and
+        acked; the caller retires the cell (``shutdown``) once STAT
+        reports inflight == 0 and unacked == 0."""
+        self.server.drain()
+        try:
+            self.lease.mark(DRAINING_PREFIX + self.endpoint)
+        except (ConnectionError, OSError):
+            pass                 # KV unreachable: DRNG NACKs still gate
 
     def crash(self):
         """The injected-kill path: the whole 'process' dies — server,
@@ -560,6 +626,11 @@ class ReplicaClient:
                 # is invalid for the model, on any replica
                 raise ValueError("replica rejected %s: %s"
                                  % (rid, bytes(payload).decode()))
+            if op == "DRNG":
+                # typed drain NACK: healthy replica, closed admissions
+                # — the router re-dispatches elsewhere (no retry here:
+                # this endpoint will keep refusing)
+                raise ReplicaDraining(rid)
             if op != "OK":
                 raise ConnectionError("SUBM reply %s" % op)
         return self._call("fleet.subm", body)
@@ -731,6 +802,8 @@ class Router:
         self._queue = collections.deque()    # rids awaiting dispatch
         self._replicas = {}      # slot -> {"endpoint","client"}
         self._inflight = {}      # slot -> set(rid)
+        self._draining = set()   # slots closed to NEW dispatch (polled
+        #                          for in-flight results until retired)
         self._affinity = collections.OrderedDict()  # session -> slot
         self._seq = itertools.count()
         self._submits_since_sweep = 0
@@ -741,7 +814,7 @@ class Router:
         # ptpu_fleet_* metrics mirror them)
         self.stats = {"requests": 0, "completed": 0, "shed": 0,
                       "resubmissions": 0, "duplicates": 0,
-                      "evictions": {}, "failed": 0}
+                      "evictions": {}, "failed": 0, "drain_nacks": 0}
         self._threads = [
             threading.Thread(target=self._registry_loop, daemon=True,
                              name="ptpu-%s-registry" % name),
@@ -839,9 +912,16 @@ class Router:
         return [h.result(timeout=timeout) for h in handles]
 
     def replicas(self):
-        """Live replica map {slot: endpoint} as the router sees it."""
+        """Live replica map {slot: endpoint} as the router sees it
+        (draining slots included — they still serve POLL/CANC)."""
         with self._lock:
             return {s: r["endpoint"] for s, r in self._replicas.items()}
+
+    def draining(self):
+        """Slots currently closed to new dispatch (drain mark seen in
+        the registry, or a DRNG NACK received ahead of it)."""
+        with self._lock:
+            return set(self._draining)
 
     def wait_for_replicas(self, n, timeout=30.0):
         """Block until the router has resolved >= n live replicas."""
@@ -990,6 +1070,9 @@ class Router:
                                         retry=self._retry),
             }
             self._inflight.setdefault(slot, set())
+            # a fresh incarnation starts dispatchable — the drain mark
+            # belonged to the slot's PREVIOUS holder
+            self._draining.discard(slot)
             self._cv.notify_all()
         t = threading.Thread(
             target=self._poller_loop, args=(slot, endpoint),
@@ -1008,6 +1091,7 @@ class Router:
             if info is None or info["endpoint"] != endpoint:
                 return False             # already handled / replaced
             del self._replicas[slot]
+            self._draining.discard(slot)
             rids = self._inflight.pop(slot, set())
             for rid in list(rids):
                 entry = self._journal.get(rid)
@@ -1035,14 +1119,28 @@ class Router:
     def _registry_loop(self):
         while not self._stop.wait(self._refresh):
             try:
-                live = _membership.live_endpoints(self._kv, self.role)
+                raw = _membership.live_endpoints(self._kv, self.role)
             except RETRYABLE:
                 continue
-            live = {s: ep for s, ep in live.items()
-                    if not ep.startswith(EVICTED_PREFIX)}
+            live, marked = {}, set()
+            for slot, ep in raw.items():
+                if ep.startswith(EVICTED_PREFIX):
+                    continue
+                if ep.startswith(DRAINING_PREFIX):
+                    # drain-marked lease: STILL LIVE (the poller keeps
+                    # draining in-flight results) but closed to new
+                    # dispatch; strip the mark to recover the endpoint
+                    ep = ep[len(DRAINING_PREFIX):]
+                    marked.add(slot)
+                live[slot] = ep
             with self._lock:
                 known = {s: r["endpoint"]
                          for s, r in self._replicas.items()}
+                # a drain mark is terminal for the incarnation: union
+                # new marks (a DRNG NACK may have added one ahead of
+                # the registry), drop slots that left the registry
+                self._draining |= marked
+                self._draining &= set(live)
             for slot, ep in known.items():
                 if live.get(slot) != ep:
                     # lease expired (dead) or a replacement claimed the
@@ -1077,8 +1175,11 @@ class Router:
                         FLEET_QUEUE_DEPTH.set(len(self._queue),
                                               router=self.name)
                     if self._queue:
+                        # draining slots are NOT dispatch candidates
+                        # (they'd NACK); they still serve POLL/CANC
                         loads = {s: len(self._inflight.get(s, ()))
-                                 for s in self._replicas}
+                                 for s in self._replicas
+                                 if s not in self._draining}
                         entry = self._journal[self._queue[0]]
                         slot = choose_replica(
                             loads, self._window,
@@ -1117,6 +1218,26 @@ class Router:
                         version_pin=entry.get("version_pin"))
             except RETRYABLE:
                 self._replica_down(slot, info["endpoint"], "dispatch")
+            except ReplicaDraining:
+                # typed drain NACK: the replica is healthy but closed
+                # to admissions (we raced its drain mark). Requeue
+                # WITHOUT burning the attempt budget — admission was
+                # refused, not tried — and stop dispatching to the
+                # slot even before the lease mark propagates.
+                with self._cv:
+                    self._draining.add(slot)
+                    e2 = self._journal.get(rid)
+                    if e2 is not None and e2["state"] == _INFLIGHT \
+                            and e2["replica"] == slot:
+                        self._inflight.get(slot, set()).discard(rid)
+                        e2["replica"] = None
+                        e2["attempts"] -= 1
+                        e2["state"] = _QUEUED
+                        self._queue.appendleft(rid)
+                        self.stats["drain_nacks"] += 1
+                        FLEET_QUEUE_DEPTH.set(len(self._queue),
+                                              router=self.name)
+                        self._cv.notify_all()
             except Exception as e:
                 # typed rejection (BADR) or another terminal error:
                 # fail THIS request, not the replica

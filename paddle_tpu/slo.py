@@ -46,6 +46,14 @@ block count via ``max_value``; percentile defaults to 1.0 = the
 window's max). Only the row surfaces carry it (--log / watch); a
 metrics snapshot has no per-step series to gate.
 
+``version_convergence_s`` / ``roll_shed`` (ISSUE 18) gate the elastic
+fleet's rolling weight updates from ``roll`` recorder rows
+(serving.autoscale): time from roll start to 100% of replicas serving
+the new artifact version (completed rolls only — metrics surface reads
+the ``ptpu_fleet_version_convergence_seconds`` histogram), and
+requests shed while a roll was in flight (``max_value: 0`` declares
+"a roll must not shed"; row surfaces only, like kv_used_blocks).
+
 ``goodput_fraction`` (ISSUE 11) gates the monitor.goodput wall-time
 attribution — productive seconds over measured wall — computed from
 the same recorder rows (a HIGHER-is-better objective: ``min_ratio``
@@ -115,13 +123,19 @@ LATENCY_METRICS = {
     # serving.sparse.measure_staleness (sparse_staleness recorder
     # rows / the ptpu_sparse_staleness_seconds histogram)
     "staleness_s": "ptpu_sparse_staleness_seconds",
+    # rolling-weight-update convergence (ISSUE 18): start of a roll ->
+    # 100% of the fleet serving the new artifact version, stamped by
+    # serving.autoscale into `roll` recorder rows and the
+    # ptpu_fleet_version_convergence_seconds histogram (aborted rolls
+    # contribute NO sample — they never converged)
+    "version_convergence_s": "ptpu_fleet_version_convergence_seconds",
 }
 
 # gauge-valued objectives (thresholds are plain values, not seconds):
 # kv_used_blocks gates paged-KV pool pressure from the serving_step
 # rows' kv_used_blocks field (ISSUE 10) — an operator bounds "how full
 # may the pool run" the same way they bound a latency percentile
-GAUGE_METRICS = ("kv_used_blocks",)
+GAUGE_METRICS = ("kv_used_blocks", "roll_shed")
 
 
 def _signals():
@@ -211,7 +225,8 @@ def _empty_samples(source):
     return {"source": source, "requests": 0, "errors": 0,
             "ttft": [], "tpot": [], "queue_wait": [],
             "step_latency": [], "kv_used_blocks": [],
-            "staleness_s": [], "request_rows": [],
+            "staleness_s": [], "version_convergence_s": [],
+            "roll_shed": [], "request_rows": [],
             "timed_samples": {},
             "goodput": None, "histograms": {}, "skipped": 0}
 
@@ -284,6 +299,20 @@ def samples_from_events(events, source="events",
                 out["staleness_s"].append(float(e["value"]))
                 if e.get("ts") is not None:
                     _timed("staleness_s", e["ts"], e["value"])
+        elif ev == "roll":
+            # serving.autoscale rolling-update rows (ISSUE 18):
+            # convergence only from COMPLETED rolls (an aborted roll
+            # never reached 100% new-version), shed-during from every
+            # roll — aborted or not, shed requests burned real budget
+            if not e.get("aborted") \
+                    and e.get("convergence_s") is not None:
+                out["version_convergence_s"].append(
+                    float(e["convergence_s"]))
+                if e.get("ts") is not None:
+                    _timed("version_convergence_s", e["ts"],
+                           e["convergence_s"])
+            if e.get("shed_during") is not None:
+                out["roll_shed"].append(float(e["shed_during"]))
     return out
 
 

@@ -193,10 +193,17 @@ def test_tail_dump_rows_are_merge_consumable(tmp_path):
 
 def test_dump_verb_conformance():
     """Satellite: DUMP is a first-class fleet verb — fault-injectable
-    and classified idempotent for the retry policy."""
+    and classified idempotent for the retry policy. Every verb of the
+    autoscaler's control server (serving.autoscale, ISSUE 18) must be
+    classed the same way — the RT02 verb-conformance lint holds its
+    dispatch loop to the fleet contract."""
     from paddle_tpu.resilience import retry
     assert "DUMP" in faults._DEFAULT_OPS
     assert retry.VERB_CLASSES["DUMP"] == "idempotent"
+    for op in ("METR", "HLTH", "DUMP", "CLKS"):
+        assert op in faults._DEFAULT_OPS, op
+        assert retry.VERB_CLASSES[op] == "idempotent", op
+    assert retry.VERB_CLASSES["EXIT"] == "admin"
 
 
 def _dump(endpoint, body=b"{}"):
@@ -237,6 +244,18 @@ def test_dump_reply_pserver_kv_telemetry(tmp_path):
         assert out["role"] == "replica"
         assert len([r for r in out["spans"]
                     if r.get("ev") == "span"]) <= 1
+        # the autoscaler's control loop is a fleet citizen too (ISSUE
+        # 18): its DUMP carries the controller state snapshot
+        from paddle_tpu.serving.autoscale import ControlServer
+        ctl = ControlServer(lambda: {"desired": 2, "live": 2,
+                                     "phase": "steady"}).start()
+        try:
+            out = _dump(ctl.endpoint)
+            assert out["role"] == "autoscaler"
+            assert out["state"]["desired"] == 2
+            assert out["state"]["phase"] == "steady"
+        finally:
+            ctl.stop()
     finally:
         kv.shutdown_server()
         kv.close()
