@@ -715,6 +715,239 @@ def main():
     autoscale_summary = guarded("autoscale-probe", autoscale_probe,
                                 errors)
 
+    def rollout_probe():
+        """ISSUE-19 canary-rollout probe, CPU-pinned like the fleet
+        probes: (a) mirror-path overhead — the same mixed request set
+        through a plain 2-replica fleet vs an autoscaler-managed
+        fleet whose router carries the (DISARMED) mirror machinery,
+        interleaved A/B windows: the per-submit mirror check and the
+        idle mirror thread must be invisible to the serving path
+        (<1%% budget); (b) a full shadow -> canary -> promote rollout
+        under live traffic — bursts keep flowing through the router
+        while candidates score mirrored copies, serve the canary
+        split, and the autoscaler rolls the fleet to v2 — stamping
+        the verdicts, the shed count (contract: 0), token identity
+        across the whole pipeline, and the p95 TTFT inflation during
+        the rollout vs a steady window (delta-histogram over
+        ptpu_serving_ttft_seconds)."""
+        import shutil
+        import tempfile
+        import threading
+        import jax
+        import numpy as np
+        from paddle_tpu import monitor, serving
+        from paddle_tpu.distributed.membership import KVServer, KVClient
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.monitor.metrics import bucket_percentile
+        from paddle_tpu.monitor.runtime import SERVING_TTFT
+        from paddle_tpu.serving import fleet
+        from paddle_tpu.serving.rollout import RolloutController
+        prev = jax.config.jax_default_device
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        art_root = None
+        auto = ctl = router_a = router_b = None
+        cells_a, kvss = [], []
+        try:
+            _fresh()
+            scope = fluid.global_scope()
+            _, logits = T.transformer_lm(vocab_size=64, max_len=96,
+                                         n_layer=2, n_head=2,
+                                         d_model=64, d_inner=128)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            main = fluid.default_main_program()
+            art_root = tempfile.mkdtemp(prefix="ptpu_rollout_")
+            v1 = os.path.join(art_root, "v1")
+            v2 = os.path.join(art_root, "v2")
+            # same weights under two labels: the PASS verdict and
+            # token identity across the promotion ARE the contract
+            serving.save_lm_artifact(v1, main, scope, [logits],
+                                     2, 2, 64, 96)
+            serving.save_lm_artifact(v2, main, scope, [logits],
+                                     2, 2, 64, 96)
+            rng = np.random.RandomState(7)
+            reqs = []
+            for _ in range(12):
+                plen = int(rng.randint(1, 9))
+                prompt = [1] + rng.randint(3, 64, plen - 1).tolist()
+                reqs.append((prompt, int(rng.randint(16, 33))))
+            prompts = [p for p, _ in reqs]
+            news = [m for _, m in reqs]
+
+            # fleet A: plain replicas, no controller, no mirror ever
+            kva = KVServer(sweep_interval=0.05).start()
+            kvss.append(kva)
+            kvc = KVClient(kva.endpoint)
+            cells_a = [fleet.Replica(kvc, v1, desired=2, slots=4,
+                                     prefill_chunk=8, ttl=0.5)
+                       for _ in range(2)]
+            router_a = fleet.Router(kva.endpoint, window=8,
+                                    refresh_interval=0.05)
+            router_a.wait_for_replicas(2)
+            # fleet B: autoscaler-managed (the promotion path), same
+            # shape; its router's mirror machinery stays DISARMED for
+            # the A/B overhead windows
+            kvb = KVServer(sweep_interval=0.05).start()
+            kvss.append(kvb)
+            auto = serving.Autoscaler(
+                kvb.endpoint, v1, desired=2, min_replicas=1,
+                max_replicas=4, slots=4, ttl=0.5, interval=0.05,
+                prefill_chunk=8).start()
+            auto.wait_steady(timeout=60)
+            router_b = fleet.Router(kvb.endpoint, window=8,
+                                    refresh_interval=0.05)
+            router_b.wait_for_replicas(2)
+
+            def win(router):
+                t0 = time.perf_counter()
+                handles = [router.submit(p, m)
+                           for p, m in zip(prompts, news)]
+                out = [h.result(timeout=120) for h in handles]
+                return time.perf_counter() - t0, out
+
+            win(router_a), win(router_b)      # warm every compile
+            wins, a_dt, b_dt = 3, [], []
+            base, identical = None, True
+            for _ in range(wins):             # interleaved A/B
+                dt, out = win(router_a)
+                a_dt.append(dt)
+                base = out
+                dt, out = win(router_b)
+                b_dt.append(dt)
+                identical = identical and all(
+                    bt == rt for (bt, _), (rt, _) in zip(base, out))
+            ma, spa, _ = agg(a_dt, nd=4)
+            mb, spb, _ = agg(b_dt, nd=4)
+
+            nb = len(SERVING_TTFT.buckets) + 1
+
+            def ttft_counts():
+                return {k: list(v["counts"])
+                        for k, v in SERVING_TTFT.snapshot().items()}
+
+            def ttft_p95(before, after):
+                delta = [0] * nb
+                for k, counts in after.items():
+                    b4 = before.get(k, [0] * nb)
+                    for i in range(min(nb, len(counts))):
+                        delta[i] += counts[i] - b4[i]
+                if sum(delta) <= 0:
+                    return None
+                return bucket_percentile(SERVING_TTFT.buckets,
+                                         delta, 0.95)
+
+            snap0 = ttft_counts()
+            win(router_b)                     # steady TTFT window
+            steady_p95 = ttft_p95(snap0, ttft_counts())
+
+            # (b) the full verdict-gated pipeline under live traffic.
+            # The delta evaluator reads flight-recorder rows, so the
+            # probe arms a recorder session for the rollout phase.
+            # inflation bound 50x like the chaos-gated e2e test — a
+            # shadow copy's TTFT includes its queue wait at the ONE
+            # candidate carrying a sampled slice of a 2-replica
+            # fleet's traffic — plus the absolute floor: on a toy
+            # model the incumbent baseline is single-digit ms, and a
+            # ratio over a near-zero baseline reads milliseconds of
+            # structural queueing as a huge regression
+            spec = {"delta": {
+                "window_s": 300.0, "min_pairs": 6, "min_requests": 6,
+                "objectives": [
+                    {"metric": "delta_ttft", "percentile": 0.95,
+                     "max_inflation": 50.0, "min_floor_s": 0.25},
+                    {"metric": "delta_error_rate", "max_delta": 0.5},
+                    {"metric": "token_agreement", "min_ratio": 0.95},
+                ]}}
+            shed0 = router_b.stats["shed"]
+            snap1 = ttft_counts()
+            t0 = time.perf_counter()
+            roll_identical, bursts = True, 0
+            with monitor.session(log_path=os.path.join(
+                    art_root, "rollout.jsonl")):
+                ctl = RolloutController(
+                    kvb.endpoint, router_b, auto, v2, spec,
+                    # fraction < 1: one 4-slot candidate cannot absorb
+                    # a FULL mirror of 12-wide bursts without queueing
+                    # every copy behind the window cap
+                    candidates=1, shadow_fraction=0.6,
+                    canary_weight=0.3, verdict_timeout=90.0,
+                    slots=4, ttl=0.5, prefill_chunk=8)
+                done = {}
+                th = threading.Thread(
+                    target=lambda: done.update(st=ctl.run()),
+                    daemon=True)
+                th.start()
+                while th.is_alive() and bursts < 200:
+                    _, out = win(router_b)
+                    bursts += 1
+                    roll_identical = roll_identical and all(
+                        bt == rt
+                        for (bt, _), (rt, _) in zip(base, out))
+                th.join(timeout=240)
+                st = done.get("st") or ctl.status()
+            rollout_wall_s = time.perf_counter() - t0
+            rollout_p95 = ttft_p95(snap1, ttft_counts())
+            probe = {
+                "config": "transformer_lm 2L/d64 T96 artifacts, "
+                          "12 mixed reqs (16-32 new), 2 replicas "
+                          "x slots=4 + 1 candidate (CPU pin)",
+                "windows": wins,
+                "plain_s": round(ma, 4), "plain_spread_pct": spa,
+                "mirror_disarmed_s": round(mb, 4),
+                "mirror_disarmed_spread_pct": spb,
+                "mirror_overhead_pct": round(
+                    100 * (mb - ma) / ma, 2),
+                "identical": bool(identical),
+                "rollout_phase": st["phase"],
+                "rollout_verdicts": {
+                    p: v.get("verdict")
+                    for p, v in st["verdicts"].items()},
+                "rollout_s": round(st.get("convergence_s")
+                                   or rollout_wall_s, 3),
+                "rollout_bursts": bursts,
+                "rollout_shed": router_b.stats["shed"] - shed0,
+                "rollout_identical": bool(roll_identical),
+                "mirror_pairs": router_b.stats["mirror_pairs"],
+                "canary_served": router_b.stats["canary_served"],
+            }
+            if steady_p95 is not None:
+                probe["steady_ttft_p95_ms"] = round(
+                    1000 * steady_p95, 2)
+            if rollout_p95 is not None:
+                probe["rollout_ttft_p95_ms"] = round(
+                    1000 * rollout_p95, 2)
+            if steady_p95 and rollout_p95 is not None:
+                probe["rollout_ttft_inflation_pct"] = round(
+                    100 * (rollout_p95 - steady_p95) / steady_p95, 1)
+            print("rollout probe: %s" % probe, file=sys.stderr)
+            return probe
+        finally:
+            if ctl is not None:
+                try:
+                    ctl.close()
+                except Exception:
+                    pass
+            for r in (router_a, router_b):
+                if r is not None:
+                    r.close()
+            if auto is not None:
+                auto.close()
+            for c in cells_a:
+                try:
+                    c.shutdown()
+                except Exception:
+                    pass
+            for s in kvss:
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            if art_root is not None:
+                shutil.rmtree(art_root, ignore_errors=True)
+            jax.config.update("jax_default_device", prev)
+
+    rollout_summary = guarded("rollout-probe", rollout_probe, errors)
+
     def recsys_probe():
         """ISSUE-12 sparse-serving probe, CPU-pinned like the serving
         probe: DeepFM scoring against live pserver row shards through
@@ -1329,6 +1562,15 @@ def main():
         # wall clock, p95 TTFT inflation during the roll, and the
         # token-identity verdict across the v1 -> v2 weight update
         out["autoscale"] = autoscale_summary
+    if rollout_summary is not None:
+        # canary-rollout stamp (ISSUE 19): disarmed mirror-path
+        # overhead (plain vs managed fleet, interleaved A/B, <1%
+        # budget) + the full shadow -> canary -> promote pipeline
+        # under live traffic — per-phase delta verdicts, shed count
+        # (contract: 0), joined mirror pairs, p95 TTFT inflation
+        # during the rollout, and the token-identity verdict across
+        # the promotion
+        out["rollout"] = rollout_summary
     if alerts_summary is not None:
         # signal-plane stamp (ISSUE 14): armed mini-fleet alerting
         # probe — detection latency in scrape rounds from injected

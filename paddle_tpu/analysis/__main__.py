@@ -36,6 +36,7 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.serving.engine",
     "paddle_tpu.serving.fleet",
     "paddle_tpu.serving.autoscale",
+    "paddle_tpu.serving.rollout",
     "paddle_tpu.serving.kvpool",
     "paddle_tpu.serving.sampling",
     "paddle_tpu.serving.spec",

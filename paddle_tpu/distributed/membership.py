@@ -30,7 +30,7 @@ from ..trace import runtime as _trace
 __all__ = ["KVServer", "KVClient", "register_endpoint",
            "wait_for_endpoints", "live_endpoints", "role_prefix",
            "register_pserver", "wait_for_pservers", "TrainerLease",
-           "EVICTED_PREFIX", "DRAINING_PREFIX"]
+           "EVICTED_PREFIX", "DRAINING_PREFIX", "VERSION_PREFIX"]
 
 # Registry-level tombstone protocol: an evictor (serving.fleet's
 # Router) CASes a slot's endpoint to "evicted:<ep>" instead of
@@ -49,6 +49,14 @@ EVICTED_PREFIX = "evicted:"
 # it so the drain is observable. Readers strip the prefix to recover
 # the endpoint.
 DRAINING_PREFIX = "draining:"
+
+# Version mark (canary rollouts, serving.rollout): a CANDIDATE replica
+# re-marks its lease value to "version:<ver>:<ep>" so every registry
+# reader sees which artifact version the endpoint serves — the router
+# stamps it on canary dispatch spans, `monitor watch` renders the
+# version mix. Like DRAINING_PREFIX the lease stays alive; readers
+# strip "version:<ver>:" to recover the endpoint.
+VERSION_PREFIX = "version:"
 
 _REG = _metrics.registry()
 _HEARTBEATS = _REG.counter("ptpu_lease_heartbeats_total",

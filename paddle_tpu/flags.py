@@ -307,6 +307,18 @@ _register("serving_scoring_batch", int, 8,
           "serving.sparse ScoringEngine batch capacity: requests "
           "scored per compiled dispatch (short batches pad to this "
           "shape, so the compiled program never re-traces)")
+_register("serving_mirror_fraction", float, 0.25,
+          "serving.fleet shadow mirroring: deterministic fraction of "
+          "accepted decode requests duplicated to CANDIDATE replicas "
+          "while a shadow mirror is armed (scored against the "
+          "incumbent's result, never served, excluded from the "
+          "incumbent's SLO histograms)")
+_register("serving_canary_weight", float, 0.1,
+          "serving.fleet canary split: deterministic fraction of "
+          "accepted requests served FOR REAL by candidate replicas "
+          "while a canary is armed (version stamped on row/span/"
+          "lease; candidates at their window fall back to incumbents "
+          "— the split never sheds)")
 _register("serving_fleet_stall_timeout", float, 2.0,
           "serving.fleet Router response-deadline watchdog: a replica "
           "that answers no verb for this long (retry deadline "

@@ -50,7 +50,7 @@ from .metrics import (META_KEY, bucket_percentile, merge_snapshots,
 
 __all__ = ["TelemetryServer", "TelemetryClient", "Collector",
            "render_prometheus_snapshot", "maybe_arm_from_flags",
-           "TELEMETRY_ROLE", "AUTOSCALER_ROLE"]
+           "TELEMETRY_ROLE", "AUTOSCALER_ROLE", "ROLLOUT_ROLE"]
 
 TELEMETRY_ROLE = "telemetry"
 # the serving.autoscale control loop lease-registers under this role so
@@ -58,6 +58,9 @@ TELEMETRY_ROLE = "telemetry"
 # rolls) without configuration — string lives here so the monitor tier
 # needs no import of the serving tier
 AUTOSCALER_ROLE = "autoscaler"
+# serving.rollout's canary-analysis controller (ISSUE 19): same
+# contract — lease-registered, scrapeable, black-box-dumpable
+ROLLOUT_ROLE = "rollout"
 
 
 def _valid_endpoint(ep):
@@ -289,7 +292,8 @@ class Collector:
 
     def __init__(self, kv_endpoint=None, roles=("ps", "replica",
                                                 TELEMETRY_ROLE,
-                                                AUTOSCALER_ROLE),
+                                                AUTOSCALER_ROLE,
+                                                ROLLOUT_ROLE),
                  static=(), timeout=2.0):
         self._kv_endpoint = kv_endpoint
         self._roles = tuple(roles)

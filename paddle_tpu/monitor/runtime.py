@@ -284,6 +284,28 @@ FLEET_VERSION_CONVERGENCE = _REG.histogram(
     "ptpu_fleet_version_convergence_seconds",
     "rolling update start -> 100% of the fleet serving the new "
     "artifact version")
+# canary analysis plane (serving.fleet mirroring + serving.rollout,
+# ISSUE 19): shadow decode volume is counted HERE, never on the
+# incumbent serving counters (the PR-6 failed-request exclusion
+# discipline applied to mirrored traffic); joined pairs and verdicts
+# are the delta-SLO evidence a rollout is gated on
+MIRROR_TOKENS = _REG.counter(
+    "ptpu_mirror_tokens_total",
+    "tokens decoded by SHADOW candidate engines (scored, never "
+    "served; deliberately excluded from ptpu_serving_tokens_total)",
+    ("engine",))
+MIRROR_PAIRS = _REG.counter(
+    "ptpu_mirror_pairs_total",
+    "joined candidate/incumbent result pairs scored by the router",
+    ("router",))
+ROLLOUT_VERDICTS = _REG.counter(
+    "ptpu_rollout_verdicts_total",
+    "exactly-once delta-SLO verdicts emitted by rollout phases",
+    ("phase", "verdict"))
+ROLLOUT_PHASE = _REG.gauge(
+    "ptpu_rollout_phase",
+    "rollout controller phase (0 idle, 1 boot, 2 shadow, 3 canary, "
+    "4 rolling, 5 promoted, -1 rolled-back)")
 
 
 # bound on remembered per-compile cost entries: each key tuple pins its
@@ -831,7 +853,8 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
                     cache_hits=None, cache_misses=None,
                     cache_stale=None, cache_evictions=None,
                     spec_drafted=None, spec_accepted=None,
-                    spec_emitted=None, spec_dispatches=None):
+                    spec_emitted=None, spec_dispatches=None,
+                    shadow=False, version=None):
     """One engine iteration completed: gauges reflect the step, counters
     accumulate, and (recorder armed) a ``serving_step`` row lands with
     the step wall time and the active trace id so the fleet timeline
@@ -850,31 +873,44 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
     queue this iteration)."""
     k = max(1, int(k))
     d = max(k, int(dispatched or k))
-    SERVING_QUEUE_DEPTH.set(queue_depth)
-    SERVING_SLOT_OCCUPANCY.set(active / slots if slots else 0.0)
-    if kv_total is not None:
-        KV_BLOCKS_TOTAL.set(kv_total)
-    if kv_used is not None:
-        KV_BLOCKS_USED.set(kv_used)
-    if preempted:
-        SERVING_PREEMPTIONS.inc(preempted)
-    if emitted:
-        SERVING_TOKENS.inc(emitted)
-    if admitted:
-        SERVING_ADMISSIONS.inc(admitted)
-    if retired:
-        SERVING_RETIREMENTS.inc(retired)
     per = None if dt is None else dt / d
-    if dt is not None:
-        for _ in range(k):
-            SERVING_STEP_SECONDS.observe(per, engine=engine)
-    if d > 1:
-        MEGASTEP_DISPATCHES.inc(executor=engine)
-        MEGASTEP_STEPS.inc(k, executor=engine)
+    if shadow:
+        # SHADOW engine step (canary analysis plane): scored, never
+        # served — nothing here may tick the serving counters/gauges
+        # the SLO engine, bench and autoscaler scale_hint read. The
+        # decode volume lands on the mirror counter; the row below is
+        # marked so slo/signals readers skip it too.
+        if emitted:
+            MIRROR_TOKENS.inc(emitted, engine=engine)
+    else:
+        SERVING_QUEUE_DEPTH.set(queue_depth)
+        SERVING_SLOT_OCCUPANCY.set(active / slots if slots else 0.0)
+        if kv_total is not None:
+            KV_BLOCKS_TOTAL.set(kv_total)
+        if kv_used is not None:
+            KV_BLOCKS_USED.set(kv_used)
+        if preempted:
+            SERVING_PREEMPTIONS.inc(preempted)
+        if emitted:
+            SERVING_TOKENS.inc(emitted)
+        if admitted:
+            SERVING_ADMISSIONS.inc(admitted)
+        if retired:
+            SERVING_RETIREMENTS.inc(retired)
+        if dt is not None:
+            for _ in range(k):
+                SERVING_STEP_SECONDS.observe(per, engine=engine)
+        if d > 1:
+            MEGASTEP_DISPATCHES.inc(executor=engine)
+            MEGASTEP_STEPS.inc(k, executor=engine)
     rec = _S.rec
     if rec is not None:
         extra = {} if d == 1 else {"k": k, "megastep_dt": dt,
                                    "dispatched": d}
+        if shadow:
+            extra["shadow"] = True
+        if version is not None:
+            extra["version"] = str(version)
         if kv_used is not None:
             # pool-pressure fields (paged engines only — dense rows
             # keep their PR-6 shape): kv_used_blocks is what slo/watch
@@ -978,14 +1014,21 @@ def on_sparse_staleness(seconds, table="table"):
 
 def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,
                        tokens=0, prefill_chunks=0, prompt_len=0,
-                       trace_id=None, error=None):
+                       trace_id=None, shadow=False, version=None,
+                       error=None):
     """One request retired (or failed) — the request-level latency
     attribution tier. Histograms observe unconditionally (requests are
     rare next to decode steps, same discipline as the serving
     counters); a ``serving_request`` recorder row lands when the flight
     recorder is armed, carrying the REQUEST's trace id (not the ambient
     step's) so the fleet timeline can join request lanes."""
-    if error is not None:
+    if shadow:
+        # mirrored request (canary analysis plane): like the
+        # failed-request exclusion below but total — neither the
+        # error counter nor the latency histograms may see shadow
+        # traffic; the marked row is the delta evaluator's input.
+        pass
+    elif error is not None:
         # failed requests are the ERROR BUDGET's business only: their
         # retire stamp is the failure time (a kill/wedge gap, not
         # decode pace), so observing them would fail latency
@@ -1007,6 +1050,10 @@ def on_serving_request(engine, queue_wait=None, ttft=None, tpot=None,
                "prompt_len": prompt_len}
         if trace_id is not None:
             row["trace"] = trace_id
+        if shadow:
+            row["shadow"] = True
+        if version is not None:
+            row["version"] = str(version)
         if error is not None:
             row["error"] = error
         rec.record("serving_request", **row)
@@ -1105,6 +1152,78 @@ def on_roll(from_version, to_version, convergence_s=None, replaced=0,
         if reason is not None:
             row["reason"] = reason
         rec.record("roll", **row)
+        rec.flush()
+
+
+def on_mirror_pair(version, rid, agree, match, router="router",
+                   candidate_error=None):
+    """One joined shadow pair scored by the router: the candidate's
+    result for a mirrored request matched against the incumbent's
+    SERVED result for the same durable rid. ``agree`` is exact token
+    equality, ``match`` the common-prefix fraction — the
+    token-agreement delta objective's samples. The row keeps
+    ``{version, rid}`` so either side's serving_request row is
+    joinable by rid."""
+    MIRROR_PAIRS.inc(router=router)
+    rec = _S.rec
+    if rec is not None:
+        row = {"version": str(version), "rid": rid,
+               "agree": bool(agree), "match": float(match),
+               "router": router}
+        if candidate_error is not None:
+            row["candidate_error"] = candidate_error
+        rec.record("mirror_pair", **row)
+
+
+def on_verdict(phase, version, verdict, figures=None, pairs=None,
+               requests=None, reason=None, rule=None):
+    """One EXACTLY-ONCE delta-SLO verdict (monitor.signals DeltaRule /
+    serving.rollout): a rollout phase's candidate either PASSed or
+    FAILed its delta objectives. Ticks the verdict counter and — armed
+    — lands a flushed ``verdict`` row (the gate record `monitor watch`
+    and the rollout controller read)."""
+    ROLLOUT_VERDICTS.inc(phase=phase, verdict=verdict)
+    rec = _S.rec
+    if rec is not None:
+        row = {"phase": phase, "version": str(version),
+               "verdict": verdict, "figures": figures or {}}
+        if pairs is not None:
+            row["pairs"] = int(pairs)
+        if requests is not None:
+            row["requests"] = int(requests)
+        if reason is not None:
+            row["reason"] = reason
+        if rule is not None:
+            row["rule"] = rule
+        rec.record("verdict", **row)
+        rec.flush()
+
+
+_ROLLOUT_PHASES = {"idle": 0, "boot": 1, "shadow": 2, "canary": 3,
+                   "rolling": 4, "promoted": 5, "rolled-back": -1}
+
+
+def on_rollout(phase, version, detail=None, version_mix=None,
+               convergence_s=None):
+    """Rollout controller phase transition (serving.rollout). The
+    gauge carries the live phase for scrape; the flushed ``rollout``
+    row is what feeds the `monitor watch` status line — no parallel
+    machinery, the collector already ships recorder rows."""
+    ROLLOUT_PHASE.set(_ROLLOUT_PHASES.get(phase, 0))
+    if version_mix:
+        for ver, n in version_mix.items():
+            FLEET_VERSION_REPLICAS.set(int(n), version=str(ver))
+    rec = _S.rec
+    if rec is not None:
+        row = {"phase": phase, "version": str(version)}
+        if detail is not None:
+            row["detail"] = detail
+        if version_mix:
+            row["version_mix"] = {str(k): int(v)
+                                  for k, v in version_mix.items()}
+        if convergence_s is not None:
+            row["convergence_s"] = float(convergence_s)
+        rec.record("rollout", **row)
         rec.flush()
 
 

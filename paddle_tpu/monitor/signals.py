@@ -75,7 +75,8 @@ import time
 from .recorder import percentile_sorted, read_jsonl_tolerant
 
 __all__ = [
-    "ScaleHint", "Signals", "Rule", "BurnRule", "SeriesWindow",
+    "ScaleHint", "Signals", "Rule", "BurnRule", "DeltaRule",
+    "SeriesWindow",
     "DEFAULT_RULES", "burn_pairs", "window_counts",
     "validate_budget_objective", "is_budget_objective",
     "build_rules", "render_transition", "active_alerts_line",
@@ -482,6 +483,121 @@ class BurnRule:
         return fire, clear
 
 
+class DeltaRule:
+    """Candidate-vs-incumbent delta verdict over a mirrored window
+    (ISSUE 19). ``Signals.feed_events`` forwards serving_request and
+    mirror_pair recorder rows to ``observe_row``; ``figure`` stays
+    pending (value None) until ``min_pairs`` joined shadow pairs AND
+    ``min_requests`` per side have landed inside ``window_s``, then
+    decides EXACTLY ONCE via ``slo.evaluate_delta`` and emits the
+    verdict row through ``monitor.runtime.on_verdict``. A FAIL verdict
+    fires through the normal Signals edge machinery — offender
+    correlation, tail-trace retention, forensics capture — at
+    severity "page"; a PASS verdict never fires and the rule goes
+    inert (a verdict is a decision, not a pressure level, so the
+    state machine's clear hold is effectively infinite).
+    ``force("FAIL", reason)`` decides immediately without waiting for
+    the gates — the rollout controller's forced-rollback path."""
+
+    kind = "delta"
+
+    def __init__(self, delta, version, phase="shadow", name=None,
+                 severity="page"):
+        from .. import slo as _slo
+        self.delta = _slo.validate_delta_spec(delta)
+        self.version = str(version)
+        self.phase = str(phase)
+        self.name = name or "delta:%s:%s" % (self.phase, self.version)
+        if severity not in SEVERITIES:
+            raise ValueError("rule %r severity %r not in %s"
+                             % (self.name, severity, SEVERITIES))
+        self.severity = severity
+        self.window_s = float(self.delta.get("window_s", 120.0))
+        self.min_pairs = int(self.delta.get("min_pairs", 8))
+        self.min_requests = int(self.delta.get("min_requests", 8))
+        self.sm = _StateMachine(1, 10 ** 9)
+        self._events = collections.deque(maxlen=65536)
+        self.verdict = None        # None until decided: "PASS"/"FAIL"
+        self.report = None         # evaluate_delta dict (or forced)
+        self._forced = None
+
+    # -- feeding ------------------------------------------------------------
+    def observe_row(self, e, ts):
+        if self.verdict is not None:
+            return                 # decided: stop buffering
+        ev = e.get("ev")
+        if ev == "serving_request":
+            if self.phase != "shadow" and e.get("shadow"):
+                # a CANARY verdict judges canary-SERVED traffic: a
+                # late mirror copy draining out of the shadow phase
+                # is not evidence about the split (and counting it
+                # could satisfy the request gate before a single
+                # canary request was sampled)
+                return
+        elif not (ev == "mirror_pair"
+                  and str(e.get("version")) == self.version):
+            return
+        if e.get("ts") is None:
+            e = dict(e, ts=ts)
+        self._events.append(e)
+
+    def force(self, verdict, reason="forced"):
+        """Decide immediately (rollout controller override); the next
+        evaluate() round emits the exactly-once verdict edge."""
+        if self.verdict is None and self._forced is None:
+            self._forced = (str(verdict).upper(), str(reason))
+
+    # -- figure -------------------------------------------------------------
+    def _decide(self, verdict, report):
+        from . import runtime as _monrt
+        self.verdict = verdict
+        self.report = report
+        self._events.clear()
+        _monrt.on_verdict(
+            self.phase, self.version, verdict,
+            figures=report.get("objectives"),
+            pairs=report.get("pairs"),
+            requests=report.get("cand_requests"),
+            reason=report.get("reason"), rule=self.name)
+
+    def figure(self, signals, now):
+        if self.verdict is None and self._forced is not None:
+            v, why = self._forced
+            self._decide(v, {"pass": v == "PASS", "forced": True,
+                             "reason": why, "version": self.version,
+                             "pairs": 0, "cand_requests": 0,
+                             "inc_requests": 0, "objectives": []})
+        if self.verdict is not None:
+            figs = {"verdict": self.verdict, "version": self.version,
+                    "phase": self.phase}
+            if isinstance(self.report, dict):
+                figs["report"] = self.report
+            return (1.0 if self.verdict == "FAIL" else 0.0), figs
+        from .. import slo as _slo
+        ds = _slo.delta_samples_from_events(
+            self._events, self.version, window_s=self.window_s,
+            now=now)
+        pend = {"pending": True, "pairs": ds["pairs"],
+                "cand_requests": ds["cand"]["requests"],
+                "inc_requests": ds["inc"]["requests"],
+                "min_pairs": self.min_pairs,
+                "min_requests": self.min_requests}
+        if (ds["pairs"] < self.min_pairs
+                or ds["cand"]["requests"] < self.min_requests
+                or ds["inc"]["requests"] < self.min_requests):
+            return None, pend
+        rep = _slo.evaluate_delta(self.delta, ds)
+        self._decide("PASS" if rep["pass"] else "FAIL", rep)
+        return (0.0 if rep["pass"] else 1.0), {
+            "verdict": self.verdict, "version": self.version,
+            "phase": self.phase, "report": rep}
+
+    def conditions(self, value):
+        if value is None:
+            return False, False    # pending: hold, never auto-clear
+        return value >= 1.0, False
+
+
 # rule-name -> constructor kwargs. Thresholds are serving-shaped
 # defaults; a spec's "rules" object overrides any field (or disables a
 # rule with false). The windows are short on purpose — these are
@@ -725,13 +841,33 @@ class Signals:
         row_mode = self._counter_mode != "snapshot"
         if row_mode:
             self._counter_mode = "rows"
+        delta_rules = [r for r in self._rules
+                       if hasattr(r, "observe_row")]
         for e in events:
             ts = e.get("ts")
             if ts is None:
                 ts = time.time() if now is None else float(now)
             self._note_ts(ts)
             ev = e.get("ev")
+            if delta_rules and ev in ("serving_request",
+                                      "mirror_pair"):
+                for r in delta_rules:
+                    r.observe_row(e, ts)
             if ev == "serving_request":
+                if e.get("shadow"):
+                    # mirrored copy: scored, never served — it must
+                    # not move the incumbent's SLO samples, counters,
+                    # or gauges (the PR-6 exclusion discipline, now
+                    # applied to a whole request class). An ERRORED
+                    # shadow row still lands in the offender ring so
+                    # a FAIL delta verdict can name its traces.
+                    if e.get("error"):
+                        self._offenders.append({
+                            "ts": ts, "trace": e.get("trace"),
+                            "proc": e.get("proc"),
+                            "engine": e.get("engine"),
+                            "why": str(e.get("error"))[:120]})
+                    continue
                 err = e.get("error")
                 self._rows.append((ts, bool(err), {
                     k: e.get(k) for k in ("ttft", "tpot",
@@ -761,6 +897,12 @@ class Signals:
                         ts, self._row_totals["errors"])
                     self._sw("shed").add(ts, self._row_totals["shed"])
             elif ev == "serving_step":
+                if e.get("shadow"):
+                    # candidate engine scoring mirrored work: its
+                    # queue depth / occupancy must not vote in the
+                    # summed gauges scale_hint() and the pressure
+                    # rules read — shadow load is not live pressure
+                    continue
                 if e.get("dt") is not None:
                     # per-logical-step engine latency: the sample a
                     # step_latency burn rule windows over

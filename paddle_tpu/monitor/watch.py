@@ -33,7 +33,7 @@ import time
 from .recorder import percentile_sorted as _pct
 
 __all__ = ["watch", "watch_fleet", "WatchState", "render_frame",
-           "staleness_lines", "fleet_lines"]
+           "staleness_lines", "fleet_lines", "rollout_line"]
 
 
 class _Tail:
@@ -101,6 +101,12 @@ class WatchState:
         # scale_event / roll row feed the dashboard's autoscale line
         self.last_scale_event = None
         self.last_roll = None
+        # canary-rollout rows (serving.rollout, ISSUE 19): newest
+        # phase-transition row + per-phase delta verdicts feed the
+        # rollout status line — same recorder rows the collector
+        # already ships, no parallel machinery
+        self.last_rollout = None
+        self.verdicts = {}         # phase -> newest verdict row
 
     def feed_line(self, line, source=""):
         e = self.parse_line(line)
@@ -159,6 +165,10 @@ class WatchState:
             self.last_scale_event = e
         elif ev == "roll":
             self.last_roll = e
+        elif ev == "rollout":
+            self.last_rollout = e
+        elif ev == "verdict":
+            self.verdicts[e.get("phase") or "?"] = e
 
     def goodput_rollup(self):
         """Per-SOURCE rolling ledgers rolled up per process — NEVER a
@@ -355,6 +365,36 @@ def fleet_lines(fleet_snap, now=None, state=None):
     return lines
 
 
+def rollout_line(state):
+    """The canary-rollout status line (ISSUE 19): live phase, version
+    mix, per-phase delta verdicts, and — once promoted — the
+    version-convergence time. Rendered from the newest ``rollout`` /
+    ``verdict`` recorder rows (file mode tails them, fleet mode ships
+    them through the collector — one source either way). None while
+    no rollout has ever run (quiet fleets keep byte-identical
+    frames)."""
+    ro = state.last_rollout
+    if ro is None:
+        return None
+    line = "  rollout  %s   phase %s" % (ro.get("version", "?"),
+                                         ro.get("phase", "?"))
+    if state.verdicts:
+        vs = " ".join(
+            "%s:%s" % (p, v.get("verdict", "?"))
+            for p, v in sorted(state.verdicts.items()))
+        line += "   verdicts %s" % vs
+    mix = ro.get("version_mix")
+    if mix:
+        line += "   versions %s" % " ".join(
+            "%s:%d" % (k, int(n)) for k, n in sorted(mix.items())
+            if int(n))
+    if ro.get("detail"):
+        line += "   (%s)" % ro["detail"]
+    if ro.get("convergence_s") is not None:
+        line += "   convergence %.1fs" % float(ro["convergence_s"])
+    return line
+
+
 def render_frame(state, path, slo_verdict=None, now=None,
                  staleness=None, fleet=None, alerts_line=None,
                  incidents_line=None):
@@ -374,6 +414,9 @@ def render_frame(state, path, slo_verdict=None, now=None,
         lines[0] += "   last event %.1fs ago" % age
     if fleet is not None:
         lines.extend(fleet_lines(fleet, now=now, state=state))
+    ro_line = rollout_line(state)
+    if ro_line is not None:
+        lines.append(ro_line)
     if staleness:
         lines.extend(staleness_lines(staleness, now=now))
 

@@ -73,6 +73,7 @@ _DEFAULT_OPS = frozenset({
     "GETT", "DONE", "FAIL", "PING",                      # master
     "CAS", "DEL", "CAD", "LIST", "LEAS",                 # kv store
     "SUBM", "POLL", "CANC", "STAT",                      # serving fleet
+    "VERD",                           # rollout verdict (serving/rollout)
     "CLKS", "METR", "HLTH", "DUMP",   # clock/telemetry/forensics
                                       # (every dispatcher)
 })

@@ -65,6 +65,9 @@ VERB_CLASSES = {
     # journal, so EVERY verb is idempotent by construction
     "SUBM": "idempotent", "POLL": "idempotent", "CANC": "idempotent",
     "STAT": "idempotent",
+    # rollout controller (serving/rollout.py): VERD is a read of the
+    # current delta-verdict state — safe to re-issue
+    "VERD": "idempotent",
     # clock/telemetry/forensics reads served by every dispatcher +
     # shutdown (DUMP is a read-only snapshot: safe to re-issue)
     "CLKS": "idempotent", "METR": "idempotent", "HLTH": "idempotent",

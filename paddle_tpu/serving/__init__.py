@@ -85,6 +85,7 @@ from .engine import (Engine, Request,  # noqa: F401
 from .fleet import (Overloaded, Replica, ReplicaClient,  # noqa: F401
                     ReplicaDraining, ReplicaServer, Router, Supervisor)
 from .autoscale import Autoscaler  # noqa: F401
+from .rollout import RolloutController  # noqa: F401
 from .kvpool import (BlockPool, RadixCache,  # noqa: F401
                      bytes_per_block)
 from .sampling import SamplingParams  # noqa: F401
@@ -94,7 +95,8 @@ from .artifact import (engine_from_artifact,  # noqa: F401
 
 __all__ = ["Engine", "Request", "sequential_generate", "Router",
            "Replica", "ReplicaServer", "ReplicaClient", "Supervisor",
-           "Overloaded", "ReplicaDraining", "Autoscaler", "BlockPool",
+           "Overloaded", "ReplicaDraining", "Autoscaler",
+           "RolloutController", "BlockPool",
            "RadixCache", "bytes_per_block", "SamplingParams",
            "NgramDrafter", "engine_from_artifact",
            "model_from_artifact", "save_lm_artifact"]

@@ -280,6 +280,16 @@ class Engine:
         self.model = model
         self.slots = int(slots)
         self.name = name
+        # canary analysis plane (serving.fleet / serving.rollout):
+        # `shadow` marks every row/metric this engine emits as mirrored
+        # traffic — scored, never served — so the incumbent's SLO
+        # histograms and the autoscaler's load signals never see it
+        # (the PR-6 failed-request exclusion discipline, applied to
+        # shadow decodes). `version` stamps the artifact version on
+        # serving_request rows so candidate-vs-incumbent delta
+        # objectives can split samples by version.
+        self.shadow = False
+        self.version = None
         self._chunk = int(prefill_chunk
                           if prefill_chunk is not None
                           else _flag("serving_prefill_chunk", 16))
@@ -1076,7 +1086,8 @@ class Engine:
                     active=active, slots=self.slots, queue_depth=depth,
                     emitted=emitted, admitted=admitted,
                     retired=len(finished), engine=self.name, dt=dt,
-                    k=steps_run, dispatched=trips, **kv)
+                    k=steps_run, dispatched=trips,
+                    shadow=self.shadow, version=self.version, **kv)
                 for req, _ in finished:
                     self._retire_telemetry(req)
         finally:
@@ -1125,6 +1136,7 @@ class Engine:
                           if ctx is not None
                           and (ctx.sampled or _trc.tail_armed())
                           else None),
+                shadow=self.shadow, version=self.version,
                 error=None if error is None else repr(error))
             req._span.annotate(
                 **{k: v for k, v in lat.items() if v is not None})

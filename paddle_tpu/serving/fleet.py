@@ -86,6 +86,7 @@ import json
 import threading
 import time
 import uuid
+import zlib
 
 from ..distributed import membership as _membership
 from ..distributed.membership import KVClient
@@ -100,10 +101,18 @@ from .engine import Engine, _flag
 
 __all__ = ["Overloaded", "ReplicaDraining", "ReplicaServer", "Replica",
            "ReplicaClient", "Router", "FleetRequest", "Supervisor",
-           "choose_replica", "REPLICA_ROLE", "EVICTED_PREFIX",
-           "DRAINING_PREFIX"]
+           "choose_replica", "REPLICA_ROLE", "CANDIDATE_ROLE",
+           "EVICTED_PREFIX", "DRAINING_PREFIX", "VERSION_PREFIX"]
 
 REPLICA_ROLE = "replica"
+# Candidate replicas (canary analysis plane, ISSUE 19) register under
+# their OWN role so the incumbent registry, its Supervisor and the
+# collector's default discovery never see them; the router resolves
+# the role only while a mirror is armed and keys candidate slots at
+# _CAND_BASE + <registry slot> so one journal/poller/dedup machinery
+# serves both populations (exactly-once holds across the split).
+CANDIDATE_ROLE = "candidate"
+_CAND_BASE = 1 << 20
 # Stall-evicted slots are TOMBSTONED (CAS endpoint -> marker) rather
 # than deleted: a delete would let the wedged holder's lease thread
 # reclaim the slot with its create-if-absent CAS, while a changed value
@@ -120,6 +129,11 @@ EVICTED_PREFIX = _membership.EVICTED_PREFIX
 # dispatching new work there. Registry-level protocol like
 # EVICTED_PREFIX; lives in membership, re-exported here.
 DRAINING_PREFIX = _membership.DRAINING_PREFIX
+# Canary lease mark (ISSUE 19): a candidate replica's lease value is
+# "version:<ver>:<ep>" so the registry itself carries the version the
+# endpoint serves; the router strips it during candidate resolution
+# and stamps the version on canary dispatch spans.
+VERSION_PREFIX = _membership.VERSION_PREFIX
 
 _REG = _metrics.registry()
 FLEET_REPLICAS = _REG.gauge(
@@ -150,6 +164,21 @@ FLEET_EVICTIONS = _REG.counter(
 FLEET_DUPLICATES = _REG.counter(
     "ptpu_fleet_duplicate_results_total",
     "late results for already-completed ids, deduped by the journal",
+    ("router",))
+# canary analysis plane (ISSUE 19): mirrored = shadow duplicates
+# dispatched (scored, never served), dropped = duplicates abandoned
+# best-effort (candidate dead/overloaded/timed out — NEVER affects the
+# served request), canary = requests the weighted split sent to a
+# candidate for real
+FLEET_MIRRORED = _REG.counter(
+    "ptpu_fleet_mirrored_total",
+    "requests duplicated to shadow candidate replicas", ("router",))
+FLEET_MIRROR_DROPPED = _REG.counter(
+    "ptpu_fleet_mirror_dropped_total",
+    "shadow duplicates abandoned without a joined pair", ("router",))
+FLEET_CANARY = _REG.counter(
+    "ptpu_fleet_canary_total",
+    "requests served FOR REAL by canary candidate replicas",
     ("router",))
 
 
@@ -203,6 +232,8 @@ class ReplicaServer:
         self.engine = engine
         self.slot = slot
         self.version = None        # serving artifact version (ISSUE 18)
+        self.kill_role = "replica"  # chaos-kill target role; Replica
+        # rebinds it ("candidate") so plans can crash canary cells only
         self._on_crash = on_crash
         self._draining = False     # drain state: NACK new SUBM, keep
         self._lock = threading.Lock()  # POLL/CANC/STAT serving
@@ -272,9 +303,9 @@ class ReplicaServer:
         plan = _faults._ACTIVE
         if plan is None:
             return
-        targets = ["replica"]
+        targets = [self.kill_role]
         if self.slot is not None:
-            targets.append("replica:%d" % self.slot)
+            targets.append("%s:%d" % (self.kill_role, self.slot))
         v = self._accepted
         for t in targets:
             if plan.should_kill(t, v):
@@ -470,7 +501,7 @@ class Replica:
 
     def __init__(self, kv, model, desired, slots=2, ttl=0.5,
                  role=REPLICA_ROLE, name=None, engine_factory=None,
-                 version=None, **engine_kwargs):
+                 version=None, shadow=False, **engine_kwargs):
         self.name = name or ("replica-" + uuid.uuid4().hex[:6])
         # serving artifact version (ISSUE 18 rolling updates): explicit,
         # or derived from the artifact directory name when cold-booting
@@ -480,6 +511,7 @@ class Replica:
             import os
             version = os.path.basename(os.path.normpath(model))
         self.version = version
+        self.shadow = bool(shadow)
         if engine_factory is not None:
             # non-decode cells (serving.sparse ScoringEngine): the
             # factory builds anything speaking the Engine protocol
@@ -489,6 +521,15 @@ class Replica:
         else:
             self.engine = Engine(model, slots=slots, name=self.name,
                                  **engine_kwargs)
+        # canary analysis plane (ISSUE 19): shadow cells mark every
+        # engine row/metric as mirrored (excluded from the incumbent
+        # SLO surface); the version rides serving_request rows either
+        # way so delta objectives can split samples by version
+        try:
+            self.engine.shadow = self.shadow
+            self.engine.version = self.version
+        except AttributeError:
+            pass               # slotted factory engines: rows unmarked
         self.server = ReplicaServer(self.engine, on_crash=self.crash)
         self.endpoint = self.server.endpoint
         try:
@@ -506,6 +547,20 @@ class Replica:
             raise
         self.server.slot = self.slot
         self.server.version = self.version
+        # fault kill-switches address cells by role ("candidate" /
+        # "candidate:<slot>" vs the default "replica"), so a chaos plan
+        # can kill a candidate mid-shadow without touching incumbents
+        self.server.kill_role = role
+        if role == CANDIDATE_ROLE and self.version:
+            # stamp the version on the lease (registry-level canary
+            # protocol): readers see which artifact the endpoint
+            # serves. Best-effort — STAT still reports the version.
+            try:
+                self.lease.mark("%s%s:%s" % (VERSION_PREFIX,
+                                             self.version,
+                                             self.endpoint))
+            except (ConnectionError, OSError):
+                pass
         self.server.start()
 
     def drain(self):
@@ -753,6 +808,17 @@ def choose_replica(loads, window, session=None, affinity=None):
 _QUEUED, _INFLIGHT, _DONE, _FAILED = "queued", "inflight", "done", \
     "failed"
 
+
+def _strip_marks(val):
+    """Strip lease-value marks: ``draining:``/``version:<ver>:`` ->
+    (version | None, endpoint)."""
+    ver = None
+    if val.startswith(DRAINING_PREFIX):
+        val = val[len(DRAINING_PREFIX):]
+    if val.startswith(VERSION_PREFIX):
+        ver, val = val[len(VERSION_PREFIX):].split(":", 1)
+    return ver, val
+
 # Completed/failed journal entries are retained this long for
 # late-duplicate dedup (the slow-replica window), then pruned — the
 # router journal must not grow with total traffic served. Session
@@ -810,16 +876,35 @@ class Router:
         self._id = uuid.uuid4().hex[:8]
         self._stop = threading.Event()
         self._closed = False
+        # canary analysis plane (ISSUE 19): one armed mirror at a time
+        # — {"mode": "shadow"|"canary", "version", "fraction"}.
+        # Shadow duplicates a sampled fraction to candidate slots
+        # (scored, never served); canary routes the sampled fraction
+        # there FOR REAL. Candidate slots live in self._replicas under
+        # _CAND_BASE-offset keys; shadow copies are tracked in their
+        # own inflight map (never the journal's) so a dropped mirror
+        # can never requeue into the serving path.
+        self._mirror = None
+        self._cand_versions = {}     # offset slot -> artifact version
+        self._mirror_queue = collections.deque()  # rids to duplicate
+        self._mirror_inflight = {}   # offset slot -> set(rid)
+        self._mirror_jobs = {}       # rid -> {"t0","inc","cand","version"}
+        self._mirror_timeout = max(30.0, 10 * self._stall_timeout)
         # instance counters (authoritative for tests; the global
         # ptpu_fleet_* metrics mirror them)
         self.stats = {"requests": 0, "completed": 0, "shed": 0,
                       "resubmissions": 0, "duplicates": 0,
-                      "evictions": {}, "failed": 0, "drain_nacks": 0}
+                      "evictions": {}, "failed": 0, "drain_nacks": 0,
+                      "mirrored": 0, "mirror_pairs": 0,
+                      "mirror_dropped": 0, "canary": 0,
+                      "canary_served": 0}
         self._threads = [
             threading.Thread(target=self._registry_loop, daemon=True,
                              name="ptpu-%s-registry" % name),
             threading.Thread(target=self._dispatch_loop, daemon=True,
                              name="ptpu-%s-dispatch" % name),
+            threading.Thread(target=self._mirror_loop, daemon=True,
+                             name="ptpu-%s-mirror" % name),
         ]
         self._pollers = {}       # slot -> thread
         for t in self._threads:
@@ -891,6 +976,26 @@ class Router:
                 "state": _QUEUED, "replica": None,
                 "attempts": 0, "handle": handle,
             }
+            mir = self._mirror
+            if mir is not None and features is None \
+                    and self._sampled(rid, mir["fraction"]):
+                if mir["mode"] == "shadow":
+                    # duplicate to a candidate, off the serving path:
+                    # the copy is scored against the incumbent's
+                    # result and joined by rid, never delivered
+                    self._mirror_jobs[rid] = {
+                        "t0": time.monotonic(), "inc": None,
+                        "cand": None, "version": mir["version"]}
+                    self._mirror_queue.append(rid)
+                    self.stats["mirrored"] += 1
+                    FLEET_MIRRORED.inc(router=self.name)
+                else:
+                    # canary split: dispatch prefers candidate slots
+                    # for this rid (incumbent fallback — the split
+                    # must never strand or shed work)
+                    self._journal[rid]["canary"] = True
+                    self.stats["canary"] += 1
+                    FLEET_CANARY.inc(router=self.name)
             self._queue.append(rid)
             self.stats["requests"] += 1
             FLEET_REQUESTS.inc(router=self.name)
@@ -922,6 +1027,98 @@ class Router:
         the registry, or a DRNG NACK received ahead of it)."""
         with self._lock:
             return set(self._draining)
+
+    # -- canary analysis plane (ISSUE 19) ----------------------------------
+    @staticmethod
+    def _sampled(rid, fraction):
+        """Deterministic per-request sampling decision, keyed on the
+        durable rid — a resubmitted id samples identically, and a
+        replayed log reproduces the same mirror population."""
+        if fraction >= 1.0:
+            return True
+        if fraction <= 0.0:
+            return False
+        return (zlib.crc32(rid.encode()) & 0xffff) / 65536.0 < fraction
+
+    def arm_shadow(self, version, fraction=None):
+        """Arm SHADOW mirroring: a deterministic ``fraction`` sample of
+        accepted decode requests is duplicated to candidate replicas
+        (role ``candidate``) — scored against the incumbent's served
+        result, joined by rid into ``mirror_pair`` rows, never served,
+        never counted in the incumbent's SLO histograms."""
+        frac = float(fraction if fraction is not None
+                     else _flag("serving_mirror_fraction", 0.25))
+        with self._cv:
+            self._mirror = {"mode": "shadow", "version": str(version),
+                            "fraction": frac}
+            self._cv.notify_all()
+
+    def arm_canary(self, version, weight=None):
+        """Arm the CANARY split: the sampled ``weight`` fraction of
+        accepted requests is served FOR REAL by candidate replicas
+        (version stamped on row/span/lease); everything else stays on
+        incumbents. Candidates at their window — or dead — fall back
+        to incumbents: the split can shift load but never shed."""
+        frac = float(weight if weight is not None
+                     else _flag("serving_canary_weight", 0.1))
+        with self._cv:
+            self._mirror = {"mode": "canary", "version": str(version),
+                            "fraction": frac}
+            self._cv.notify_all()
+
+    def disarm_mirror(self):
+        """Return to single-version routing: stop sampling, abandon
+        pending shadow work (best-effort by contract), and evict
+        candidate slots from dispatch — their unfinished CANARY
+        requests resubmit to incumbents, so exactly-once completion
+        holds through a rollback."""
+        with self._cv:
+            self._mirror = None
+            self._mirror_queue.clear()
+            dropped = len(self._mirror_jobs)
+            self._mirror_jobs.clear()
+            if dropped:
+                self.stats["mirror_dropped"] += dropped
+                FLEET_MIRROR_DROPPED.inc(dropped, router=self.name)
+            cands = [(s, self._replicas[s]["endpoint"])
+                     for s in self._replicas if s >= _CAND_BASE]
+            self._cv.notify_all()
+        for slot, ep in cands:
+            self._replica_down(slot, ep, "mirror_disarmed")
+
+    def mirror_status(self):
+        """Live mirror snapshot: mode/version/fraction, resolved
+        candidate slots (un-offset), and the pair/drop ledger."""
+        with self._lock:
+            return {
+                "mirror": dict(self._mirror) if self._mirror else None,
+                "candidates": {
+                    s - _CAND_BASE: self._replicas[s]["endpoint"]
+                    for s in self._replicas if s >= _CAND_BASE},
+                "versions": {s - _CAND_BASE: v for s, v
+                             in self._cand_versions.items()},
+                "pending": len(self._mirror_queue)
+                + sum(1 for j in self._mirror_jobs.values()
+                      if j["cand"] is None),
+                "pairs": self.stats["mirror_pairs"],
+                "dropped": self.stats["mirror_dropped"],
+            }
+
+    def wait_for_candidates(self, n, timeout=30.0):
+        """Block until >= n candidate replicas are resolved and
+        dispatchable (mirror must be armed — resolution is gated on
+        it)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                have = sum(1 for s in self._replicas
+                           if s >= _CAND_BASE
+                           and s not in self._draining)
+            if have >= n:
+                return have
+            time.sleep(0.02)
+        raise TimeoutError("router resolved %d of %d candidates"
+                           % (have, n))
 
     def wait_for_replicas(self, n, timeout=30.0):
         """Block until the router has resolved >= n live replicas."""
@@ -983,6 +1180,11 @@ class Router:
     def _fail_entry(self, entry, err):
         entry["state"] = _FAILED
         self.stats["failed"] += 1
+        if self._mirror_jobs.pop(entry["rid"], None) is not None:
+            # no served result, no pair: the mirror copy is abandoned
+            # (best-effort by contract)
+            self.stats["mirror_dropped"] += 1
+            FLEET_MIRROR_DROPPED.inc(router=self.name)
         h = entry["handle"]
         if h.t_done is None:
             h.t_done = time.perf_counter()
@@ -995,6 +1197,15 @@ class Router:
         even a duplicate: the replica may forget it either way)."""
         rid = res.get("id")
         with self._cv:
+            if slot >= _CAND_BASE and rid in self._mirror_jobs:
+                # SHADOW copy's result from a candidate: never
+                # delivered — stash it and try the join. (A canary
+                # result from a candidate slot is NOT in _mirror_jobs
+                # and falls through to the normal path below.)
+                self._mirror_inflight.get(slot, set()).discard(rid)
+                self._mirror_jobs[rid]["cand"] = res
+                self._try_join_locked(rid)
+                return True
             entry = self._journal.get(rid)
             if entry is None:
                 return True              # unknown id (pruned/foreign)
@@ -1025,6 +1236,11 @@ class Router:
                 self._inflight.get(cur, set()).discard(rid)
             entry["state"] = _DONE
             self.stats["completed"] += 1
+            if cur is not None and cur >= _CAND_BASE:
+                # canary-served for real by a candidate (the forced-
+                # FAIL gate asserts this stays 0 when a rollout never
+                # reaches the canary phase)
+                self.stats["canary_served"] += 1
             h = entry["handle"]
             h.tokens = list(res["tokens"])
             h.score = res["score"]
@@ -1033,8 +1249,45 @@ class Router:
             h.resubmits = max(0, entry["attempts"] - 1)
             h.t_done = time.perf_counter()
             h._event.set()
+            job = self._mirror_jobs.get(rid)
+            if job is not None:
+                # incumbent side of a shadow pair: stash the SERVED
+                # tokens for the join (order-independent with the
+                # candidate's result)
+                job["inc"] = {"tokens": list(h.tokens)}
+                self._try_join_locked(rid)
             self._cv.notify_all()        # capacity freed
         return True
+
+    def _try_join_locked(self, rid):
+        """Join one shadow pair (under the lock): once BOTH the
+        incumbent's served tokens and the candidate's scored result
+        are stashed, score agreement (exact token equality) and match
+        (common-prefix fraction) and emit the ``mirror_pair`` row the
+        token-agreement delta objective samples. A candidate-side
+        error joins as a disagreeing pair carrying the error — the
+        error-rate delta's evidence."""
+        job = self._mirror_jobs.get(rid)
+        if job is None or job["inc"] is None or job["cand"] is None:
+            return
+        del self._mirror_jobs[rid]
+        inc_toks = job["inc"]["tokens"]
+        cand = job["cand"]
+        cerr = cand.get("error")
+        if cerr is None:
+            ctoks = list(cand.get("tokens") or ())
+            agree = ctoks == inc_toks
+            k = 0
+            for a, b in zip(ctoks, inc_toks):
+                if a != b:
+                    break
+                k += 1
+            match = k / max(len(ctoks), len(inc_toks), 1)
+        else:
+            agree, match = False, 0.0
+        self.stats["mirror_pairs"] += 1
+        _monrt.on_mirror_pair(job["version"], rid, agree, match,
+                              router=self.name, candidate_error=cerr)
 
     def _requeue_locked(self, entry, why):
         """Under the lock: put an unfinished entry back on the dispatch
@@ -1092,12 +1345,20 @@ class Router:
                 return False             # already handled / replaced
             del self._replicas[slot]
             self._draining.discard(slot)
+            self._cand_versions.pop(slot, None)
             rids = self._inflight.pop(slot, set())
             for rid in list(rids):
                 entry = self._journal.get(rid)
                 if entry is not None and entry["state"] == _INFLIGHT:
                     self._requeue_locked(entry, "replica %d %s"
                                          % (slot, reason))
+            # shadow copies on a dead candidate are DROPPED, never
+            # requeued — the mirror is best-effort and must not feed
+            # work back into the serving path
+            for rid in self._mirror_inflight.pop(slot, ()):
+                if self._mirror_jobs.pop(rid, None) is not None:
+                    self.stats["mirror_dropped"] += 1
+                    FLEET_MIRROR_DROPPED.inc(router=self.name)
             for sess in [s for s, r in self._affinity.items()
                          if r == slot]:
                 del self._affinity[sess]
@@ -1105,7 +1366,11 @@ class Router:
                 self.stats["evictions"].get(reason, 0) + 1
             FLEET_EVICTIONS.inc(reason=reason)
         info["client"].close()
-        key = _membership.role_prefix(self.role) + str(slot)
+        if slot >= _CAND_BASE:
+            key = (_membership.role_prefix(CANDIDATE_ROLE)
+                   + str(slot - _CAND_BASE))
+        else:
+            key = _membership.role_prefix(self.role) + str(slot)
         try:
             # tombstone (never delete): see EVICTED_PREFIX. A dead
             # holder's key may already be gone — the CAS just fails.
@@ -1122,7 +1387,7 @@ class Router:
                 raw = _membership.live_endpoints(self._kv, self.role)
             except RETRYABLE:
                 continue
-            live, marked = {}, set()
+            live, marked, versions = {}, set(), {}
             for slot, ep in raw.items():
                 if ep.startswith(EVICTED_PREFIX):
                     continue
@@ -1133,6 +1398,28 @@ class Router:
                     ep = ep[len(DRAINING_PREFIX):]
                     marked.add(slot)
                 live[slot] = ep
+            if self._mirror is not None:
+                # mirror armed: additionally resolve CANDIDATE leases
+                # under offset keys — same eviction / drain / poller
+                # machinery, separate role registry. Disarmed, the
+                # role is never read and any lingering candidate slots
+                # fall out of `live` -> evicted below.
+                try:
+                    rawc = _membership.live_endpoints(self._kv,
+                                                     CANDIDATE_ROLE)
+                except RETRYABLE:
+                    rawc = {}
+                for slot, val in rawc.items():
+                    if val.startswith(EVICTED_PREFIX):
+                        continue
+                    drain = val.startswith(DRAINING_PREFIX)
+                    ver, ep = _strip_marks(val)
+                    slot += _CAND_BASE
+                    if drain:
+                        marked.add(slot)
+                    if ver is not None:
+                        versions[slot] = ver
+                    live[slot] = ep
             with self._lock:
                 known = {s: r["endpoint"]
                          for s, r in self._replicas.items()}
@@ -1141,6 +1428,7 @@ class Router:
                 # the registry), drop slots that left the registry
                 self._draining |= marked
                 self._draining &= set(live)
+                self._cand_versions.update(versions)
             for slot, ep in known.items():
                 if live.get(slot) != ep:
                     # lease expired (dead) or a replacement claimed the
@@ -1150,8 +1438,11 @@ class Router:
                 if known.get(slot) != ep:
                     self._add_replica(slot, ep)
             with self._lock:
-                FLEET_REPLICAS.set(len(self._replicas),
-                                   router=self.name)
+                # incumbents only: candidate capacity must not inflate
+                # the fleet-size gauge the autoscaler converges on
+                FLEET_REPLICAS.set(
+                    sum(1 for s in self._replicas if s < _CAND_BASE),
+                    router=self.name)
 
     def _dispatch_loop(self):
         while True:
@@ -1181,6 +1472,24 @@ class Router:
                                  for s in self._replicas
                                  if s not in self._draining}
                         entry = self._journal[self._queue[0]]
+                        if entry.get("canary") \
+                                and entry["attempts"] == 0:
+                            # canary-sampled, first attempt: prefer
+                            # candidate slots; fall back to incumbents
+                            # when none are live/under-window (the
+                            # split must never shed or strand work).
+                            # A RESUBMISSION may land anywhere —
+                            # exactly-once completion outranks the
+                            # split after a candidate death.
+                            cand = {s: l for s, l in loads.items()
+                                    if s >= _CAND_BASE}
+                            if cand and any(l < self._window
+                                            for l in cand.values()):
+                                loads = cand
+                        elif not entry.get("canary"):
+                            # candidates never serve unsampled traffic
+                            loads = {s: l for s, l in loads.items()
+                                     if s < _CAND_BASE}
                         slot = choose_replica(
                             loads, self._window,
                             session=entry["session"],
@@ -1207,10 +1516,15 @@ class Router:
             # wire work OUTSIDE the lock; the dispatch span carries
             # rid/slot/endpoint — a resubmitted id shows N dispatch
             # spans with different endpoints (the resubmission hop)
+            attrs = {}
+            if slot >= _CAND_BASE:
+                # canary dispatch: the candidate's artifact version on
+                # the span (the row carries it via the engine)
+                attrs["version"] = self._cand_versions.get(slot)
             try:
                 with _trace.span("router.dispatch", rid=rid, slot=slot,
                                  endpoint=info["endpoint"],
-                                 attempt=entry["attempts"]):
+                                 attempt=entry["attempts"], **attrs):
                     info["client"].submit(
                         rid, entry["prompt"], entry["max_new"],
                         entry.get("sampling"),
@@ -1247,6 +1561,93 @@ class Router:
                         self._inflight.get(slot, set()).discard(rid)
                         self._fail_entry(e2, e)
 
+    def _sweep_mirror_locked(self, now):
+        """Drop shadow jobs past the mirror timeout (candidate never
+        answered / incumbent result pruned): bounded state, and a
+        wedged candidate cannot pin join stashes forever."""
+        stale = [rid for rid, j in self._mirror_jobs.items()
+                 if now - j["t0"] > self._mirror_timeout]
+        for rid in stale:
+            del self._mirror_jobs[rid]
+            self.stats["mirror_dropped"] += 1
+            FLEET_MIRROR_DROPPED.inc(router=self.name)
+            for s in self._mirror_inflight.values():
+                s.discard(rid)
+
+    def _mirror_loop(self):
+        """Dispatch SHADOW duplicates to candidate replicas — its own
+        thread with its own clients (ReplicaClient sockets are never
+        shared across threads). Best-effort by contract: a failed or
+        timed-out duplicate is dropped and counted, never requeued
+        into the serving path, and never touches the journal's state
+        machine — a broken candidate can cost pairs, not traffic."""
+        clients = {}             # offset slot -> (endpoint, client)
+        try:
+            while True:
+                with self._cv:
+                    rid = slot = None
+                    while not self._stop.is_set():
+                        self._sweep_mirror_locked(time.monotonic())
+                        if self._mirror_queue:
+                            if self._mirror_queue[0] \
+                                    not in self._mirror_jobs:
+                                # dropped (disarm/timeout/fail): skip
+                                self._mirror_queue.popleft()
+                                continue
+                            loads = {s: len(self._mirror_inflight
+                                            .get(s, ()))
+                                     for s in self._replicas
+                                     if s >= _CAND_BASE
+                                     and s not in self._draining}
+                            slot = choose_replica(loads, self._window)
+                            if slot is not None:
+                                rid = self._mirror_queue.popleft()
+                                break
+                        self._cv.wait(timeout=0.25)
+                    if rid is None:
+                        return           # stopping
+                    entry = self._journal.get(rid)
+                    if entry is None:
+                        if self._mirror_jobs.pop(rid, None) \
+                                is not None:
+                            self.stats["mirror_dropped"] += 1
+                            FLEET_MIRROR_DROPPED.inc(router=self.name)
+                        continue
+                    self._mirror_inflight.setdefault(slot,
+                                                     set()).add(rid)
+                    ep = self._replicas[slot]["endpoint"]
+                    ver = self._cand_versions.get(slot)
+                    prompt = entry["prompt"]
+                    max_new = entry["max_new"]
+                    sampling = entry.get("sampling")
+                tup = clients.get(slot)
+                if tup is None or tup[0] != ep:
+                    if tup is not None:
+                        tup[1].close()
+                    tup = (ep, ReplicaClient(
+                        ep, timeout=self._client_timeout,
+                        retry=self._retry))
+                    clients[slot] = tup
+                try:
+                    with _trace.span("router.mirror", rid=rid,
+                                     slot=slot, endpoint=ep,
+                                     version=ver):
+                        tup[1].submit(rid, prompt, max_new, sampling)
+                except Exception:
+                    # candidate unreachable / NACK / reject: drop the
+                    # copy (the served request is untouched)
+                    tup[1].close()
+                    with self._cv:
+                        self._mirror_inflight.get(slot,
+                                                  set()).discard(rid)
+                        if self._mirror_jobs.pop(rid, None) \
+                                is not None:
+                            self.stats["mirror_dropped"] += 1
+                            FLEET_MIRROR_DROPPED.inc(router=self.name)
+        finally:
+            for _, cl in clients.values():
+                cl.close()
+
     def _poller_loop(self, slot, endpoint):
         """Long-poll one replica for finished results and ack them.
         A poll that fails past the retry deadline reports the replica
@@ -1279,7 +1680,15 @@ class Router:
                         # watchdog eviction); gone = plain death.
                         reason = "stall"
                         try:
-                            if _membership.live_endpoints(
+                            if slot >= _CAND_BASE:
+                                val = _membership.live_endpoints(
+                                    self._kv, CANDIDATE_ROLE
+                                    ).get(slot - _CAND_BASE)
+                                if val is None or \
+                                        _strip_marks(val)[1] \
+                                        != endpoint:
+                                    reason = "dead"
+                            elif _membership.live_endpoints(
                                     self._kv, self.role
                                     ).get(slot) != endpoint:
                                 reason = "dead"

@@ -104,7 +104,8 @@ __all__ = [
     "load_spec", "evaluate", "samples_from_events",
     "samples_from_monitor_log", "samples_from_span_logs",
     "samples_from_metrics", "render", "main", "LATENCY_METRICS",
-    "GAUGE_METRICS",
+    "GAUGE_METRICS", "DELTA_LATENCY_METRICS", "validate_delta_spec",
+    "delta_samples_from_events", "evaluate_delta",
 ]
 
 # objective metric -> metrics-snapshot histogram. step_latency is the
@@ -169,6 +170,12 @@ def load_spec(source):
         # own loop
         _signals().build_rules({"rules": spec["rules"],
                                 "objectives": []})
+    if spec.get("delta") is not None:
+        # canary delta objectives (ISSUE 19) live in their own block:
+        # they gate candidate-vs-incumbent figures no single-version
+        # sample set carries, so they never mix into the objectives
+        # ladder above
+        validate_delta_spec(spec["delta"])
     for i, obj in enumerate(objectives):
         metric = obj.get("metric")
         if _signals().is_budget_objective(obj):
@@ -264,6 +271,13 @@ def samples_from_events(events, source="events",
 
     for e in events:
         ev = e.get("ev")
+        if e.get("shadow"):
+            # mirrored traffic (canary analysis plane, ISSUE 19):
+            # scored, never served — excluded from the incumbent
+            # verdict wholesale, the same way failed requests are
+            # excluded from latency. The DELTA evaluator below reads
+            # these rows instead.
+            continue
         if ev == "serving_request":
             out["requests"] += 1
             if e.get("ts") is not None:
@@ -414,6 +428,223 @@ def samples_from_metrics(source):
     out["requests"] = \
         _counter_total("ptpu_serving_retirements_total") + failures
     return out
+
+
+# -- delta objectives (canary analysis plane, ISSUE 19) --------------------
+#
+# A candidate model is gated against the INCUMBENT, not against fixed
+# thresholds: the spec's optional "delta" block declares
+# candidate-vs-incumbent objectives evaluated over a mirrored window —
+#
+#     "delta": {
+#       "window_s": 120, "min_pairs": 8, "min_requests": 8,
+#       "objectives": [
+#         {"metric": "delta_ttft",       "percentile": 0.95,
+#          "max_inflation": 1.5},
+#         {"metric": "delta_tpot",       "percentile": 0.95,
+#          "max_inflation": 1.5},
+#         {"metric": "delta_queue_wait", "percentile": 0.95,
+#          "max_inflation": 2.0},
+#         {"metric": "delta_error_rate", "max_delta": 0.02},
+#         {"metric": "token_agreement",  "min_ratio": 0.98}
+#       ]
+#     }
+#
+# delta_* latency metrics measure percentile INFLATION (candidate pN /
+# incumbent pN, same nearest-rank _pct); delta_error_rate the error-
+# fraction difference; token_agreement the exact-agreement fraction
+# over joined mirror_pair rows. Like every objective: no samples on
+# either side = FAIL with a reason.
+
+DELTA_LATENCY_METRICS = ("delta_ttft", "delta_tpot",
+                         "delta_queue_wait")
+
+
+def validate_delta_spec(delta):
+    """Validate one delta block (raises ValueError — same loud-at-load
+    contract as load_spec)."""
+    if not isinstance(delta, dict):
+        raise ValueError("'delta' must be an object")
+    objectives = delta.get("objectives")
+    if not isinstance(objectives, list) or not objectives:
+        raise ValueError("delta block needs a non-empty 'objectives' "
+                         "list")
+    for k in ("window_s", "min_pairs", "min_requests"):
+        if delta.get(k) is not None \
+                and not isinstance(delta[k], (int, float)):
+            raise ValueError("delta %r must be numeric" % k)
+    for i, obj in enumerate(objectives):
+        metric = obj.get("metric")
+        if metric in DELTA_LATENCY_METRICS:
+            if not isinstance(obj.get("max_inflation"), (int, float)):
+                raise ValueError(
+                    "delta objective %d (%s) needs numeric "
+                    "'max_inflation'" % (i, metric))
+            q = obj.get("percentile", 0.95)
+            if not (0.0 < float(q) <= 1.0):
+                raise ValueError(
+                    "delta objective %d percentile %r outside (0, 1]"
+                    % (i, q))
+            floor = obj.get("min_floor_s")
+            if floor is not None and (
+                    not isinstance(floor, (int, float))
+                    or float(floor) <= 0.0):
+                raise ValueError(
+                    "delta objective %d (%s) 'min_floor_s' must be "
+                    "a positive number" % (i, metric))
+        elif metric == "delta_error_rate":
+            if not isinstance(obj.get("max_delta"), (int, float)):
+                raise ValueError(
+                    "delta objective %d (delta_error_rate) needs "
+                    "numeric 'max_delta'" % i)
+        elif metric == "token_agreement":
+            r = obj.get("min_ratio")
+            if not isinstance(r, (int, float)) \
+                    or not (0.0 < float(r) <= 1.0):
+                raise ValueError(
+                    "delta objective %d (token_agreement) needs "
+                    "'min_ratio' in (0, 1]" % i)
+        else:
+            raise ValueError(
+                "delta objective %d names unknown metric %r (known: "
+                "%s, delta_error_rate, token_agreement)"
+                % (i, metric, ", ".join(DELTA_LATENCY_METRICS)))
+    return delta
+
+
+def delta_samples_from_events(events, version, window_s=None,
+                              now=None):
+    """Candidate-vs-incumbent sample split from flight-recorder rows.
+
+    ``serving_request`` rows are classified CANDIDATE when stamped
+    with the candidate ``version`` (mirrored or canary-served for
+    real), INCUMBENT otherwise — except ``shadow`` rows from a foreign
+    version (another rollout, warm-up priming), which count on neither
+    side. The same rows samples_from_events reads, split instead of
+    filtered. ``mirror_pair`` rows for the
+    version feed the token-agreement score. ``window_s``/``now`` bound
+    the mirrored window by row timestamp."""
+    version = str(version)
+
+    def _bucket():
+        return {"requests": 0, "errors": 0, "ttft": [], "tpot": [],
+                "queue_wait": []}
+
+    out = {"version": version, "pairs": 0, "agree": 0, "match": [],
+           "cand": _bucket(), "inc": _bucket()}
+    for e in events:
+        ev = e.get("ev")
+        if window_s is not None and now is not None \
+                and e.get("ts") is not None \
+                and now - float(e["ts"]) > float(window_s):
+            continue
+        if ev == "serving_request":
+            cand = str(e.get("version")) == version
+            if bool(e.get("shadow")) and not cand:
+                # mirrored row from a FOREIGN version (another
+                # rollout's shadow, or a candidate's warm-up priming
+                # request stamped "__prime__"): PR-6 already keeps it
+                # off the incumbent surface, and it is not evidence
+                # about THIS candidate either — neither side
+                continue
+            b = out["cand" if cand else "inc"]
+            b["requests"] += 1
+            if e.get("error"):
+                b["errors"] += 1
+                continue               # PR-6 exclusion, per side
+            for k in ("ttft", "tpot", "queue_wait"):
+                if e.get(k) is not None:
+                    b[k].append(float(e[k]))
+        elif ev == "mirror_pair" \
+                and str(e.get("version")) == version:
+            out["pairs"] += 1
+            if e.get("agree"):
+                out["agree"] += 1
+            if e.get("match") is not None:
+                out["match"].append(float(e["match"]))
+    return out
+
+
+def evaluate_delta(delta, dsamples):
+    """-> delta verdict dict: {"pass", "version", "pairs",
+    "cand_requests", "inc_requests", "objectives": [{metric, threshold,
+    measured, pass, reason?}]}. Pure function of (validated delta
+    block, delta_samples_from_events output) — the batch CLI gate and
+    the live DeltaRule (monitor/signals.py) share it."""
+    delta = validate_delta_spec(delta)
+    cand, inc = dsamples["cand"], dsamples["inc"]
+    results = []
+    for obj in delta["objectives"]:
+        metric = obj["metric"]
+        if metric in DELTA_LATENCY_METRICS:
+            base = metric[len("delta_"):]
+            q = float(obj.get("percentile", 0.95))
+            ent = {"metric": metric, "percentile": q,
+                   "threshold": float(obj["max_inflation"]),
+                   "cand_n": len(cand[base]), "inc_n": len(inc[base]),
+                   "measured": None}
+            if not cand[base] or not inc[base]:
+                ent.update({"pass": False,
+                            "reason": "no %s samples"
+                            % ("candidate" if not cand[base]
+                               else "incumbent")})
+            else:
+                cp = _pct(sorted(cand[base]), q)
+                ip = _pct(sorted(inc[base]), q)
+                ent["measured"] = cp / max(ip, 1e-9)
+                ent["pass"] = ent["measured"] <= ent["threshold"]
+                floor = obj.get("min_floor_s")
+                if floor is not None:
+                    # ratio inflation over a near-zero incumbent
+                    # baseline reads single-digit-ms queueing as a
+                    # huge regression: an absolute floor says
+                    # "candidate latency this small is not a
+                    # regression, whatever the ratio"
+                    ent["min_floor_s"] = float(floor)
+                    if not ent["pass"] and cp <= float(floor):
+                        ent["pass"] = True
+                        ent["reason"] = ("inflation %.1fx over "
+                                         "threshold but candidate "
+                                         "p%d %.4fs under the "
+                                         "%.4fs floor"
+                                         % (ent["measured"],
+                                            round(q * 100), cp,
+                                            float(floor)))
+        elif metric == "delta_error_rate":
+            ent = {"metric": metric,
+                   "threshold": float(obj["max_delta"]),
+                   "cand_n": cand["requests"],
+                   "inc_n": inc["requests"], "measured": None}
+            if not cand["requests"] or not inc["requests"]:
+                ent.update({"pass": False,
+                            "reason": "no %s requests"
+                            % ("candidate" if not cand["requests"]
+                               else "incumbent")})
+            else:
+                ent["measured"] = (
+                    cand["errors"] / cand["requests"]
+                    - inc["errors"] / inc["requests"])
+                ent["pass"] = ent["measured"] <= ent["threshold"]
+        else:                            # token_agreement
+            ent = {"metric": metric,
+                   "threshold": float(obj["min_ratio"]),
+                   "pairs": dsamples["pairs"], "measured": None}
+            if not dsamples["pairs"]:
+                ent.update({"pass": False,
+                            "reason": "no joined mirror pairs"})
+            else:
+                ent["measured"] = (dsamples["agree"]
+                                   / dsamples["pairs"])
+                ent["pass"] = ent["measured"] >= ent["threshold"]
+        if obj.get("name"):
+            ent["name"] = obj["name"]
+        results.append(ent)
+    return {"pass": all(r["pass"] for r in results),
+            "version": dsamples.get("version"),
+            "pairs": dsamples.get("pairs", 0),
+            "cand_requests": cand["requests"],
+            "inc_requests": inc["requests"],
+            "objectives": results}
 
 
 # -- evaluation ------------------------------------------------------------

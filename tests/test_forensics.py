@@ -204,6 +204,10 @@ def test_dump_verb_conformance():
         assert op in faults._DEFAULT_OPS, op
         assert retry.VERB_CLASSES[op] == "idempotent", op
     assert retry.VERB_CLASSES["EXIT"] == "admin"
+    # the rollout controller's verdict read (serving.rollout, ISSUE
+    # 19) joins the same contract: fault-injectable + idempotent
+    assert "VERD" in faults._DEFAULT_OPS
+    assert retry.VERB_CLASSES["VERD"] == "idempotent"
 
 
 def _dump(endpoint, body=b"{}"):
@@ -256,6 +260,24 @@ def test_dump_reply_pserver_kv_telemetry(tmp_path):
             assert out["state"]["phase"] == "steady"
         finally:
             ctl.stop()
+        # ...and so is the rollout controller (serving.rollout, ISSUE
+        # 19): DUMP carries its state, VERD its per-phase verdicts
+        from paddle_tpu.serving.rollout import (RolloutServer,
+                                                fetch_verdicts)
+        rctl = RolloutServer(
+            lambda: {"phase": "shadow", "version": "v2"},
+            lambda: {"phase": "shadow", "version": "v2",
+                     "verdicts": {}}).start()
+        try:
+            out = _dump(rctl.endpoint)
+            assert out["role"] == "rollout"
+            assert out["state"]["phase"] == "shadow"
+            assert out["state"]["version"] == "v2"
+            verd = fetch_verdicts(rctl.endpoint)
+            assert verd["phase"] == "shadow"
+            assert verd["verdicts"] == {}
+        finally:
+            rctl.stop()
     finally:
         kv.shutdown_server()
         kv.close()
