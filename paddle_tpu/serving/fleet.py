@@ -1109,26 +1109,30 @@ class Router:
         dispatchable (mirror must be armed — resolution is gated on
         it)."""
         deadline = time.time() + timeout
-        while time.time() < deadline:
+        while True:
             with self._lock:
                 have = sum(1 for s in self._replicas
                            if s >= _CAND_BASE
                            and s not in self._draining)
             if have >= n:
                 return have
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    "router resolved %d of %d candidates" % (have, n))
             time.sleep(0.02)
-        raise TimeoutError("router resolved %d of %d candidates"
-                           % (have, n))
 
     def wait_for_replicas(self, n, timeout=30.0):
         """Block until the router has resolved >= n live replicas."""
         deadline = time.time() + timeout
-        while time.time() < deadline:
-            if len(self.replicas()) >= n:
-                return self.replicas()
+        while True:
+            reps = self.replicas()
+            if len(reps) >= n:
+                return reps
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    "router resolved %d of %d replicas"
+                    % (len(reps), n))
             time.sleep(0.02)
-        raise TimeoutError("router resolved %d of %d replicas"
-                           % (len(self.replicas()), n))
 
     def close(self):
         """Stop the router. Journaled requests not yet completed fail
@@ -1209,6 +1213,18 @@ class Router:
             entry = self._journal.get(rid)
             if entry is None:
                 return True              # unknown id (pruned/foreign)
+            if slot >= _CAND_BASE and not entry.get("canary"):
+                # LATE SHADOW result whose mirror job was already
+                # dropped (disarm, sweep timeout, or candidate
+                # eviction — the poller drains for a grace window
+                # past all three): ack and drop. Only canary-marked
+                # entries may ever be completed by a candidate slot;
+                # anything else would serve candidate-generated
+                # tokens from an unvetted artifact — a rolled-back
+                # rollout must have served ZERO candidate-only tokens.
+                self.stats["mirror_dropped"] += 1
+                FLEET_MIRROR_DROPPED.inc(router=self.name)
+                return True
             if "error" in res:
                 # replica-side failure (its engine died mid-request):
                 # at-least-once dispatch handles it — requeue for a
@@ -1366,16 +1382,37 @@ class Router:
                 self.stats["evictions"].get(reason, 0) + 1
             FLEET_EVICTIONS.inc(reason=reason)
         info["client"].close()
+        if reason == "mirror_disarmed":
+            # disarm eviction is ROUTER-LOCAL bookkeeping: the
+            # candidate cell is healthy and its lease must survive —
+            # the shadow->canary flip (and a later rollout) re-resolves
+            # the same holders when the mirror re-arms. Tombstoning
+            # here would make the live holder's keepalive lose and
+            # turn every flip into a reap-and-respawn cycle.
+            return True
         if slot >= _CAND_BASE:
             key = (_membership.role_prefix(CANDIDATE_ROLE)
                    + str(slot - _CAND_BASE))
         else:
             key = _membership.role_prefix(self.role) + str(slot)
         try:
-            # tombstone (never delete): see EVICTED_PREFIX. A dead
-            # holder's key may already be gone — the CAS just fails.
-            self._kv.cas(key, endpoint, EVICTED_PREFIX + endpoint,
-                         ttl=max(10.0, 4 * self._stall_timeout))
+            # tombstone (never delete): see EVICTED_PREFIX. The live
+            # value may carry marks — candidates boot as
+            # ``version:<ver>:<ep>``, drains re-mark ``draining:<ep>``
+            # — so CAS against what the registry ACTUALLY holds; a
+            # bare-endpoint expect would never match a marked lease,
+            # the wedged holder's expect-guarded keepalive would keep
+            # winning, and stall recovery would degrade into evict /
+            # re-add churn instead of a supervisor respawn. A dead
+            # holder's key may already be gone (get -> None) or the
+            # value may have moved between get and CAS — the CAS just
+            # fails; tombstoning is best-effort either way.
+            cur = self._kv.get(key)
+            if cur is not None \
+                    and not cur.startswith(EVICTED_PREFIX) \
+                    and _strip_marks(cur)[1] == endpoint:
+                self._kv.cas(key, cur, EVICTED_PREFIX + endpoint,
+                             ttl=max(10.0, 4 * self._stall_timeout))
         except RETRYABLE:
             pass
         return True

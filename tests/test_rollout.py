@@ -338,6 +338,98 @@ def test_shadow_engine_rows_skip_serving_metrics(tmp_path):
         assert ("cand-eng",) not in occ
 
 
+def test_late_candidate_result_never_completes_serving_entry():
+    """A candidate's LATE shadow result — its mirror job already
+    dropped by disarm/sweep while the grace-window poller kept
+    draining — must be acked-and-dropped, never fall through to the
+    journal and complete the still-pending serving entry with
+    candidate-generated tokens (the 'rollback serves zero
+    candidate-only tokens' invariant). Canary-marked entries are the
+    one legitimate candidate-completion path and must stay open."""
+    kvs = KVServer(sweep_interval=0.05).start()
+    kv = KVClient(kvs.endpoint)
+    router = None
+    try:
+        router = Router(kvs.endpoint, refresh_interval=5.0,
+                        name="lateshadow")
+        cand = fleet._CAND_BASE + 0
+
+        # shadow entry whose mirror job was dropped (disarm): the
+        # late candidate result must not touch the journal entry
+        router.arm_shadow("v2", fraction=1.0)
+        h = router.submit([1, 2, 3], 4)
+        rid = h.rid
+        assert rid in router._mirror_jobs
+        router.disarm_mirror()
+        dropped0 = router.stats["mirror_dropped"]
+        assert router._complete(
+            cand, {"id": rid, "tokens": [9, 9, 9], "score": 0.0})
+        with router._lock:
+            entry = router._journal[rid]
+            assert entry["state"] == "queued"
+            assert not h._event.is_set()
+        assert router.stats["completed"] == 0
+        assert router.stats["canary_served"] == 0
+        assert router.stats["mirror_dropped"] == dropped0 + 1
+
+        # canary-marked entry: a candidate slot MAY complete it
+        router.arm_canary("v2", weight=1.0)
+        h2 = router.submit([1, 2, 3], 4)
+        with router._lock:
+            assert router._journal[h2.rid].get("canary")
+        assert router._complete(
+            cand, {"id": h2.rid, "tokens": [7, 8], "score": 0.5})
+        assert h2.result(timeout=5) == ([7, 8], 0.5)
+    finally:
+        if router is not None:
+            router.close()
+        try:
+            kv.shutdown_server()
+            kv.close()
+        except OSError:
+            pass
+
+
+def test_stall_evicted_candidate_tombstone_sticks():
+    """Evicting a candidate must tombstone its MARKED lease value
+    ('version:<ver>:<ep>' — Replica stamps it at boot): a
+    bare-endpoint CAS never matches a marked lease, so the wedged
+    holder's expect-guarded keepalive would keep winning and stall
+    recovery would degrade into evict/re-add churn instead of the
+    rollout controller's bounded respawn."""
+    from paddle_tpu.distributed import membership as _mem
+    kvs = KVServer(sweep_interval=0.05).start()
+    kv = KVClient(kvs.endpoint)
+    router = None
+    try:
+        router = Router(kvs.endpoint, refresh_interval=5.0,
+                        name="tomb")
+        ep = "127.0.0.1:59999"
+        key = _mem.role_prefix(fleet.CANDIDATE_ROLE) + "0"
+        kv.put(key, fleet.VERSION_PREFIX + "v2:" + ep, ttl=30.0)
+        slot = fleet._CAND_BASE + 0
+
+        class _Client:
+            def close(self):
+                pass
+
+        with router._cv:
+            router._replicas[slot] = {"endpoint": ep,
+                                      "client": _Client()}
+            router._inflight.setdefault(slot, set())
+            router._cand_versions[slot] = "v2"
+        assert router._replica_down(slot, ep, "stall")
+        assert kv.get(key) == fleet.EVICTED_PREFIX + ep
+    finally:
+        if router is not None:
+            router.close()
+        try:
+            kv.shutdown_server()
+            kv.close()
+        except OSError:
+            pass
+
+
 # -- the chaos gate ---------------------------------------------------------
 
 CHAOS_SPEC = {
