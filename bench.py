@@ -271,8 +271,13 @@ def main():
             # + natural-text regimes + the bs1 dispatch-floor probe on
             # the dispatch-bound shape), stamped as spec_* fields +
             # the accepted_tokens_per_dispatch figure perfgate gates
+            # --block_probe: the ISSUE-20 block-kernel vs gather-path
+            # A/B (paged decode step at fixed tokens held across two
+            # pool capacities; int8 arm separate), stamped as block_*
+            # fields perfgate gates
             _run(["--device", "CPU", "--fast", "--megastep", "8",
-                  "--prefix_share", "32", "--speculative", "4"])
+                  "--prefix_share", "32", "--speculative", "4",
+                  "--block_probe"])
             import serving_bench as smod
             return importlib.reload(smod).main()
         finally:

@@ -338,6 +338,122 @@ def _spec_bs1_floor(args):
     return out
 
 
+def _block_kernel_ab(args):
+    """Block-kernel vs gather-path A/B (ISSUE 20): the paged decode
+    step at a FIXED context (tokens actually held) across two pool
+    capacities (max_len 4x apart). The gather path materializes the
+    dense ``[.., max_len, ..]`` axis, so its step time grows with
+    capacity at fixed context; the block kernel walks only the
+    allocated chain, so its step time tracks tokens held. Measures
+    the jitted ``_step_logits_paged`` directly (both arms share one
+    dispatch shape — no engine-loop noise), interleaved rounds,
+    medians. Stamps per-arm step ms at both capacities, the
+    large-capacity speedup, and the capacity-scaling ratio
+    (gather-growth / block-growth — the flatness figure the
+    acceptance criterion gates, >1 = the block kernel is flatter).
+    The int8-quantized arm is stamped separately at the large
+    capacity."""
+    import statistics
+    import jax
+    import jax.numpy as jnp
+
+    bs = 16
+    held = 48                           # tokens held, both capacities
+    cap_small, cap_large = 64, 256
+    slots = args.slots
+    rng = np.random.RandomState(args.seed + 3)
+
+    def build(cap):
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), \
+                fluid.scope_guard(scope):
+            T.transformer_lm(vocab_size=args.vocab, max_len=cap,
+                             n_layer=args.n_layer, n_head=args.n_head,
+                             d_model=args.d_model,
+                             d_inner=args.d_model * 4)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return TransformerLMInfer(
+                main, scope, args.n_layer, args.n_head, args.d_model,
+                cap, end_id=args.vocab)
+
+    def arm(infer, cap, block_kernel, kv_quant=None):
+        """One jitted step closure at one capacity: every slot holds
+        ``held`` tokens of KV in its own block chain. The state is
+        DONATED and threaded exactly like the engine's step — without
+        donation XLA copies the whole pool every call and the copy
+        (proportional to capacity) drowns the attention delta the
+        probe exists to measure."""
+        nbs = cap // bs
+        state = [infer._init_paged_state(slots * nbs, bs,
+                                         kv_quant=kv_quant)]
+        btab = jnp.arange(slots * nbs,
+                          dtype=jnp.int32).reshape(slots, nbs)
+        pos = jnp.full((slots,), held, jnp.int32)
+        tok = jnp.asarray(rng.randint(3, args.vocab, slots),
+                          jnp.int32)
+        fn = jax.jit(lambda t, s, p, b: infer._step_logits_paged(
+            t, s, p, b, block_kernel=block_kernel),
+            donate_argnums=(1,))
+
+        def step():
+            logits, state[0] = fn(tok, state[0], pos, btab)
+            logits.block_until_ready()
+        step()                          # compile outside the clock
+        return step
+
+    inf_s, inf_l = build(cap_small), build(cap_large)
+    arms = {
+        "gather_small": arm(inf_s, cap_small, False),
+        "block_small": arm(inf_s, cap_small, True),
+        "gather_large": arm(inf_l, cap_large, False),
+        "block_large": arm(inf_l, cap_large, True),
+        "quant_large": arm(inf_l, cap_large, True, kv_quant="int8"),
+    }
+    reps, rounds = (6, 3) if args.fast else (10, 5)
+    times = {k: [] for k in arms}
+    for _ in range(rounds):             # interleaved A/B rounds
+        for name, step in arms.items():
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                step()
+            times[name].append((time.perf_counter() - t0) / reps)
+    ms = {k: 1000.0 * statistics.median(v) for k, v in times.items()}
+    bl = times["block_large"]
+    spread = (100.0 * (max(bl) - min(bl)) * 1000.0
+              / ms["block_large"]) if ms["block_large"] else 0.0
+    gather_growth = ms["gather_large"] / ms["gather_small"]
+    block_growth = ms["block_large"] / ms["block_small"]
+    out = {
+        "block_probe_tokens_held": held,
+        "block_probe_capacities": [cap_small, cap_large],
+        "block_step_ms_small": round(ms["block_small"], 3),
+        "block_step_ms_large": round(ms["block_large"], 3),
+        "gather_step_ms_small": round(ms["gather_small"], 3),
+        "gather_step_ms_large": round(ms["gather_large"], 3),
+        "block_quant_step_ms_large": round(ms["quant_large"], 3),
+        "block_kernel_speedup": round(
+            ms["gather_large"] / ms["block_large"], 2),
+        "block_kernel_quant_speedup": round(
+            ms["gather_large"] / ms["quant_large"], 2),
+        # flatness: how much faster the gather arm grows with
+        # capacity than the block arm does (>1 = block is flatter)
+        "block_kernel_scale_ratio": round(
+            gather_growth / block_growth, 2),
+        "block_kernel_spread_pct": round(spread, 1),
+    }
+    print("block-kernel A/B (%d tokens held, capacity %d->%d): "
+          "block %.2f->%.2f ms vs gather %.2f->%.2f ms "
+          "(%.2fx at large, scale ratio %.2f, quant %.2f ms)"
+          % (held, cap_small, cap_large, ms["block_small"],
+             ms["block_large"], ms["gather_small"],
+             ms["gather_large"], out["block_kernel_speedup"],
+             out["block_kernel_scale_ratio"], ms["quant_large"]),
+          file=sys.stderr)
+    return out
+
+
 def main():
     args = parse_args(
         "serving_bench", batch_size=0, iterations=1, skip=0,
@@ -375,6 +491,13 @@ def main():
                                 "set + the bs1 dispatch-floor probe, "
                                 "stamped as spec_* fields (0 = "
                                 "skip)"),
+            p.add_argument("--block_probe", action="store_true",
+                           help="also measure the block-kernel vs "
+                                "gather-path A/B (ISSUE 20): paged "
+                                "decode step time at fixed tokens "
+                                "held across two pool capacities, "
+                                "stamped as block_* fields (the "
+                                "quantized arm separately)"),
             p.add_argument("--fast", action="store_true",
                            help="tier-1 CPU smoke: smaller request set")))
     import jax
@@ -523,6 +646,9 @@ def _run_bench(args):
 
     if args.speculative > 0 and eng._paged:
         out.update(_speculative_ab(args, infer))
+
+    if args.block_probe and eng._paged:
+        out.update(_block_kernel_ab(args))
 
     if eng._paged:
         # pool stats of the main pass (the paged engine's whole run)

@@ -44,6 +44,7 @@ IMPORT_CHECK_PACKAGES = (
     "paddle_tpu.serving.sparse.cache",
     "paddle_tpu.serving.sparse.scoring",
     "paddle_tpu.serving.sparse.online",
+    "paddle_tpu.ops.paged_attention",
     "paddle_tpu.reader",
     "paddle_tpu.reader.device_loader",
     "paddle_tpu.slo",

@@ -244,6 +244,27 @@ _register("serving_kv_blocks", int, 0,
           "it below that to trade concurrency headroom for memory "
           "(the engine preempts the lowest-priority request when the "
           "pool runs dry)")
+_register("serving_block_kernel", bool, True,
+          "block-native paged attention (ISSUE 20): walk each slot's "
+          "allocated block chain with online softmax — compute and "
+          "bandwidth scale with tokens held, not pool capacity "
+          "(Pallas kernel on TPU, blocked lax fallback on CPU). 0 = "
+          "the PR-10 dense-gather escape hatch; fp32 outputs are "
+          "token-identical either way. Requires serving_paged")
+_register("serving_kv_quant", str, "",
+          "paged-KV pool quantization: '' (off, dense pool dtype), "
+          "'int8' (symmetric per-(position,head)-vector scales stored "
+          "beside the pool; ~0.4%/element error budget, serving "
+          "outputs rtol-pinned at 2e-2), or 'fp8' (float8_e4m3fn, "
+          "where the runtime provides it). Quantize on cache write, "
+          "dequantize inside the kernel block loop; bytes_per_block "
+          "and the autoparallel HBM filter price the smaller pool. "
+          "Requires serving_paged + serving_block_kernel")
+_register("serving_attn_unroll", int, 1,
+          "block-kernel chain-walk group size: blocks gathered and "
+          "scored per online-softmax update on the CPU/lax path "
+          "(fewer, fatter iterations; the Pallas path grids over "
+          "single blocks regardless). Numerics-neutral at any value")
 _register("serving_prefix_cache", bool, True,
           "radix prefix cache over prompt blocks: an admission whose "
           "prompt shares a cached full-block prefix skips those "

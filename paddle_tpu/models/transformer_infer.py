@@ -20,8 +20,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import decoding
+from ..ops import paged_attention as _paged_ops
 
 __all__ = ["extract_params", "TransformerInfer"]
+
+# every array a paged state dict may carry for the KV pool itself:
+# codes + (when quantized, ISSUE 20) the per-vector scales beside them
+_POOL_KEYS = ("pool_k", "pool_v", "pool_ks", "pool_vs")
 
 
 _PARAM_OPS = {
@@ -380,34 +385,137 @@ class TransformerLMInfer(TransformerInfer):
             x = _ln(x + self._ffn(p, x), *p["ln2"])
         return x[:, 0, :] @ self.w_out, state
 
-    # -- paged KV (serving.kvpool block pool, ISSUE 10) ----------------
-    def _init_paged_state(self, num_blocks, block_size):
+    # -- paged KV (serving.kvpool block pool, ISSUE 10/20) -------------
+    def _init_paged_state(self, num_blocks, block_size, kv_quant=None):
         """Shared paged KV pool: K and V arrays of shape
         ``[num_blocks, n_layer, n_head, block_size, dk]``. Slots map
         logical cache positions to physical blocks through per-slot
         block tables (``serving.kvpool.BlockPool`` owns the host-side
         accounting); unassigned table entries read block 0, whose
         garbage the causal bias masks exactly like the dense path
-        masks a recycled slot's stale tail."""
+        masks a recycled slot's stale tail.
+
+        ``kv_quant`` ('int8' / 'fp8', ISSUE 20): the pools store codes
+        at the quantized dtype plus ONE f32 scale per cached vector —
+        ``pool_ks``/``pool_vs`` [num_blocks, n_layer, n_head,
+        block_size] beside the pool. Scales init to 1 so block 0's
+        zero codes dequantize to the exact zeros the fp32 pool holds."""
         dk = self.d_model // self.n_head
         dtype = self.word_emb.dtype
         shape = (int(num_blocks), self.n_layer, self.n_head,
                  int(block_size), dk)
-        return {"pool_k": jnp.zeros(shape, dtype),
-                "pool_v": jnp.zeros(shape, dtype)}
+        spec = _paged_ops.kv_quant_spec(kv_quant)
+        if spec is None:
+            return {"pool_k": jnp.zeros(shape, dtype),
+                    "pool_v": jnp.zeros(shape, dtype)}
+        qdtype, _ = spec
+        return {"pool_k": jnp.zeros(shape, qdtype),
+                "pool_v": jnp.zeros(shape, qdtype),
+                "pool_ks": jnp.ones(shape[:-1], jnp.float32),
+                "pool_vs": jnp.ones(shape[:-1], jnp.float32)}
+
+    # -- shared pool addressing (ISSUE 20: exactly ONE implementation) -
+    def _pool_write(self, pools, i, wphys, off, k_new, v_new):
+        """Write layer ``i``'s new K/V vectors into the pool:
+        ``k_new``/``v_new`` [S, H, C, dk] land at
+        ``(wphys[s, c], i, :, off[s, c])`` with ``wphys``/``off``
+        [S, C] int32 (C = 1 for the single decode step). Out-of-bounds
+        ``wphys`` rows (the write-mask convention: masked rows point at
+        ``num_blocks``) drop via ``mode="drop"``. THE one pool-write
+        implementation — every paged entry point (step, speculative,
+        prefill, drafter) routes here. Quantized pools quantize per
+        stored vector here (codes + per-position scale, ISSUE 20)."""
+        for name, sname, val in (
+                ("pool_k", "pool_ks", k_new), ("pool_v", "pool_vs",
+                                               v_new)):
+            v = val.transpose(0, 2, 1, 3)            # [S, C, H, dk]
+            if sname in pools:
+                codes, scale = _paged_ops.quantize_kv(
+                    v, pools[name].dtype)
+                pools[name] = pools[name].at[wphys, i, :, off, :].set(
+                    codes, mode="drop")
+                pools[sname] = pools[sname].at[wphys, i, :, off].set(
+                    scale, mode="drop")
+            else:
+                pools[name] = pools[name].at[wphys, i, :, off, :].set(
+                    v.astype(pools[name].dtype), mode="drop")
+        return pools
+
+    def _pool_gather(self, pools, i, btab):
+        """THE dense block-table gather (the ``serving_block_kernel=0``
+        escape hatch): layer ``i``'s K/V for every table row, gathered
+        in position order and sliced back to the dense
+        ``[S, H, max_len, dk]`` axis — position j of the key axis is
+        logical position j, bit-for-bit the PR-10 math. ``btab``
+        [S, max_blocks] int32 (or one [max_blocks] prefill row).
+        Quantized pools dequantize on the gathered blocks."""
+        bt = btab if btab.ndim == 2 else btab[None]
+        s = bt.shape[0]
+        dk = self.d_model // self.n_head
+        out = []
+        for name, sname in (("pool_k", "pool_ks"),
+                            ("pool_v", "pool_vs")):
+            g = pools[name][:, i][bt]        # [S, NB, H, bs, dk]
+            if sname in pools:
+                g = _paged_ops.dequantize_kv(g, pools[sname][:, i][bt])
+            out.append(g.transpose(0, 2, 1, 3, 4).reshape(
+                s, self.n_head, -1, dk)[:, :, :self.max_len])
+        return out
+
+    def _mha_paged(self, p, q_in, pools, i, btab, qpos, nblk, bias,
+                   block_kernel, attn_unroll=1):
+        """Paged-pool attention + output projection for queries
+        ``q_in`` [S, C, D]. ``block_kernel=False`` gathers the dense
+        axis through ``_pool_gather`` and runs ``_mha`` (the PR-10
+        escape hatch); ``True`` runs the ISSUE-20 block-chain kernel
+        (``ops/paged_attention``): online softmax over only the first
+        ``nblk`` block-table columns, keys at cache positions
+        ``<= qpos[s, c]`` attending — the causal-bias predicate,
+        block-walked. Both paths produce the same tokens (the identity
+        lattice pins them); the kernel's cost scales with blocks held,
+        not ``max_len``."""
+        if not block_kernel:
+            k, v = self._pool_gather(pools, i, btab)
+            return self._mha(p, q_in, k, v, bias)
+        h = self.n_head
+        q = _split_heads(q_in @ p["wq"], h)
+        dk = q.shape[-1]
+        bt = btab if btab.ndim == 2 else btab[None]
+        # FULL pool + static layer index: the kernel gathers (block,
+        # layer) pairs; a pools[name][:, i] slice here would copy the
+        # whole pool every step (capacity-proportional)
+        o = _paged_ops.paged_attention(
+            (q * (dk ** -0.5)).astype(jnp.float32),
+            pools["pool_k"], pools["pool_v"], bt, qpos,
+            nblk=nblk,
+            k_scale=pools.get("pool_ks"),
+            v_scale=pools.get("pool_vs"),
+            block_group=attn_unroll, layer=i)
+        o = o.astype(q_in.dtype)
+        r, t = q_in.shape[0], q_in.shape[1]
+        return o.transpose(0, 2, 1, 3).reshape(r, t, -1) @ p["wo"]
+
+    @staticmethod
+    def _pool_slice(state):
+        """The pool entries of a paged state dict (codes + scales)."""
+        return {n: state[n] for n in _POOL_KEYS if n in state}
 
     def _step_logits_paged(self, tok, state, pos, btab, write_mask=None,
-                           n_layers=None):
+                           n_layers=None, block_kernel=False,
+                           attn_unroll=1):
         """Per-slot incremental step over the PAGED pool: like
         ``_step_logits_slots`` but each slot's K/V live in the shared
         block pool, addressed through its block table ``btab``
-        [S, max_blocks] int32. The gathered per-slot cache is SLICED
-        back to ``[S, H, max_len, dk]`` before attention, so position
-        j of the key axis is logical position j and every reduction
-        runs over the exact dense-path axis length — greedy logits are
-        bitwise the dense step's (token identity by construction, not
-        by tolerance; pinned in tests/test_serving.py which runs the
-        whole suite over this path).
+        [S, max_blocks] int32. Pool addressing (write + read) routes
+        through the shared ``_pool_write`` / ``_mha_paged`` helpers
+        (ISSUE 20): ``block_kernel=False`` gathers the dense
+        ``[S, H, max_len, dk]`` axis so position j of the key axis is
+        logical position j and greedy logits are bitwise the dense
+        step's (the PR-10 bring-up math, now the escape hatch);
+        ``block_kernel=True`` (the engine default) walks only the
+        longest live block chain with the online-softmax kernel —
+        token streams stay pinned identical, compute stops scaling
+        with pool capacity.
 
         ``n_layers`` (a trace-time constant) runs only the FIRST n
         layers — the speculative tier-B drafter (ISSUE 13): a
@@ -415,8 +523,6 @@ class TransformerLMInfer(TransformerInfer):
         writing draft K/V only at layer rows the full-depth scoring
         dispatch immediately overwrites."""
         nb, bs = state["pool_k"].shape[0], state["pool_k"].shape[3]
-        s = tok.shape[0]
-        dk = self.d_model // self.n_head
         x = self.word_emb[tok] * (self.d_model ** 0.5) + self.pos_emb[pos]
         x = x[:, None, :]                                # [S, 1, D]
         ar = jnp.arange(self.max_len)
@@ -429,32 +535,30 @@ class TransformerLMInfer(TransformerInfer):
         # discards (the write-mask semantics of the dense path)
         wphys = phys if write_mask is None else \
             jnp.where(write_mask, phys, nb)
-        pool_k, pool_v = state["pool_k"], state["pool_v"]
+        qpos = pos[:, None]                              # [S, 1]
+        # block-walk bound: the longest LIVE chain in the batch (an
+        # idle slot's stale pos must not widen every slot's walk)
+        live = pos if write_mask is None else \
+            jnp.where(write_mask, pos, 0)
+        nblk = jnp.minimum(jnp.max(live) // bs + 1, btab.shape[1])
+        pools = self._pool_slice(state)
         layers = self.layers if n_layers is None \
             else self.layers[:n_layers]
         for i, p in enumerate(layers):
             k_new, v_new = self._kv(p["attn"], x)        # [S, H, 1, dk]
-            pool_k = pool_k.at[wphys, i, :, off, :].set(
-                k_new[:, :, 0, :], mode="drop")
-            pool_v = pool_v.at[wphys, i, :, off, :].set(
-                v_new[:, :, 0, :], mode="drop")
-            # gather THIS slot's blocks back into position order; the
-            # [:, :, :max_len] slice drops the last block's padding
-            # tail so the key axis is the dense path's, bit for bit
-            gk = pool_k[:, i][btab]          # [S, NB, H, bs, dk]
-            gv = pool_v[:, i][btab]
-            k = gk.transpose(0, 2, 1, 3, 4).reshape(
-                s, self.n_head, -1, dk)[:, :, :self.max_len]
-            v = gv.transpose(0, 2, 1, 3, 4).reshape(
-                s, self.n_head, -1, dk)[:, :, :self.max_len]
-            a = self._mha(p["attn"], x, k, v, self_bias)
+            pools = self._pool_write(pools, i, wphys[:, None],
+                                     off[:, None], k_new, v_new)
+            a = self._mha_paged(p["attn"], x, pools, i, btab, qpos,
+                                nblk, self_bias, block_kernel,
+                                attn_unroll)
             x = _ln(x + a, *p["ln1"])
             x = _ln(x + self._ffn(p, x), *p["ln2"])
-        state["pool_k"], state["pool_v"] = pool_k, pool_v
+        state.update(pools)
         return x[:, 0, :] @ self.w_out, state
 
     def _spec_logits_paged(self, toks, state, pos, btab, n_valid,
-                           write_mask=None):
+                           write_mask=None, block_kernel=False,
+                           attn_unroll=1):
         """Speculative scoring (ISSUE 13): logits at ALL ``C = γ+1``
         positions of every slot in ONE paged-attention dispatch.
         ``toks`` [S, C] holds each slot's current token followed by its
@@ -473,10 +577,16 @@ class TransformerLMInfer(TransformerInfer):
         acceptance math never reads. The causal bias masks cache
         positions beyond each query, so a rejected draft's stale K/V
         from a PREVIOUS dispatch is never attended before the dispatch
-        that re-writes it."""
+        that re-writes it.
+
+        Pool addressing rides the same ``_pool_write`` /
+        ``_mha_paged`` helpers as the single step (ISSUE 20): with
+        ``block_kernel=True`` the γ+1-query variant of the block-chain
+        kernel scores all C positions while walking only the live
+        chains — the second dense-gather path this method used to
+        carry is gone."""
         nb, bs = state["pool_k"].shape[0], state["pool_k"].shape[3]
         s, c = toks.shape
-        dk = self.d_model // self.n_head
         cpos = pos[:, None] + jnp.arange(c)[None, :]     # [S, C]
         gather_pos = jnp.minimum(cpos, self.max_len - 1)
         x = self.word_emb[toks] * (self.d_model ** 0.5) \
@@ -493,36 +603,37 @@ class TransformerLMInfer(TransformerInfer):
         if write_mask is not None:
             valid = valid & write_mask[:, None]
         wphys = jnp.where(valid, phys, nb)               # OOB → dropped
-        pool_k, pool_v = state["pool_k"], state["pool_v"]
+        qpos = jnp.minimum(cpos, self.max_len - 1)
+        live = pos if write_mask is None else \
+            jnp.where(write_mask, pos, 0)
+        nblk = jnp.minimum(jnp.max(live + (c - 1)) // bs + 1,
+                           btab.shape[1])
+        pools = self._pool_slice(state)
         for i, p in enumerate(self.layers):
             k_new, v_new = self._kv(p["attn"], x)        # [S, H, C, dk]
-            pool_k = pool_k.at[wphys, i, :, off, :].set(
-                k_new.transpose(0, 2, 1, 3), mode="drop")
-            pool_v = pool_v.at[wphys, i, :, off, :].set(
-                v_new.transpose(0, 2, 1, 3), mode="drop")
-            gk = pool_k[:, i][btab]          # [S, NB, H, bs, dk]
-            gv = pool_v[:, i][btab]
-            k = gk.transpose(0, 2, 1, 3, 4).reshape(
-                s, self.n_head, -1, dk)[:, :, :self.max_len]
-            v = gv.transpose(0, 2, 1, 3, 4).reshape(
-                s, self.n_head, -1, dk)[:, :, :self.max_len]
-            a = self._mha(p["attn"], x, k, v, bias)
+            pools = self._pool_write(pools, i, wphys, off, k_new,
+                                     v_new)
+            a = self._mha_paged(p["attn"], x, pools, i, btab, qpos,
+                                nblk, bias, block_kernel, attn_unroll)
             x = _ln(x + a, *p["ln1"])
             x = _ln(x + self._ffn(p, x), *p["ln2"])
-        state["pool_k"], state["pool_v"] = pool_k, pool_v
+        state.update(pools)
         return x @ self.w_out, state                     # [S, C, V]
 
     def _prefill_chunk_paged(self, state, toks, start, n_valid,
-                             btab_row):
+                             btab_row, block_kernel=False,
+                             attn_unroll=1):
         """Teacher-forced chunk prefill into the paged pool for ONE
         slot whose block table is ``btab_row`` [max_blocks] int32: the
         paged twin of ``_prefill_chunk_slot`` (same fixed chunk shape,
         masked padded tail, output head dead-coded). A prefix-cache
         hit never reaches here for the cached positions — the engine
         advances the cursor past them — but the chunk's attention DOES
-        read the shared cached blocks through the table."""
+        read the shared cached blocks through the table. Pool
+        addressing rides the shared ``_pool_write`` / ``_mha_paged``
+        helpers (ISSUE 20); the block kernel walks only the blocks up
+        to this chunk's last valid position."""
         nb, bs = state["pool_k"].shape[0], state["pool_k"].shape[3]
-        dk = self.d_model // self.n_head
         c = toks.shape[0]
         idx = jnp.arange(c)
         cpos = start + idx                               # [C]
@@ -538,23 +649,20 @@ class TransformerLMInfer(TransformerInfer):
         blk = jnp.minimum(cpos // bs, btab_row.shape[0] - 1)
         off = cpos % bs
         wphys = jnp.where(valid, btab_row[blk], nb)      # OOB → dropped
-        pool_k, pool_v = state["pool_k"], state["pool_v"]
+        qpos = jnp.minimum(cpos, self.max_len - 1)[None]  # [1, C]
+        nblk = jnp.minimum(
+            (start + jnp.maximum(n_valid, 1) - 1) // bs + 1,
+            btab_row.shape[0])
+        pools = self._pool_slice(state)
         for i, p in enumerate(self.layers):
             k_new, v_new = self._kv(p["attn"], x)        # [1, H, C, dk]
-            pool_k = pool_k.at[wphys, i, :, off, :].set(
-                k_new[0].transpose(1, 0, 2), mode="drop")
-            pool_v = pool_v.at[wphys, i, :, off, :].set(
-                v_new[0].transpose(1, 0, 2), mode="drop")
-            gk = pool_k[:, i][btab_row]      # [NB, H, bs, dk]
-            gv = pool_v[:, i][btab_row]
-            k = gk.transpose(1, 0, 2, 3).reshape(
-                self.n_head, -1, dk)[None][:, :, :self.max_len]
-            v = gv.transpose(1, 0, 2, 3).reshape(
-                self.n_head, -1, dk)[None][:, :, :self.max_len]
-            a = self._mha(p["attn"], x, k, v, bias)
+            pools = self._pool_write(pools, i, wphys[None], off[None],
+                                     k_new, v_new)
+            a = self._mha_paged(p["attn"], x, pools, i, btab_row, qpos,
+                                nblk, bias, block_kernel, attn_unroll)
             x = _ln(x + a, *p["ln1"])
             x = _ln(x + self._ffn(p, x), *p["ln2"])
-        state["pool_k"], state["pool_v"] = pool_k, pool_v
+        state.update(pools)
         return state
 
     def _prefill_chunk_slot(self, state, slot, toks, start, n_valid):
@@ -658,7 +766,10 @@ def analysis_entry_serving_megastep():
     scanned-unit heuristic sees the production fused body (K is a
     static trace constant: varying it recompiles the whole unit), and
     the dtype rule audits the megastep at the same bf16-weights /
-    f32-score precision contract as the plain decode entry."""
+    f32-score precision contract as the plain decode entry. Since
+    ISSUE 20 the engine default routes attention through the
+    block-chain kernel, so the traced body carries the dynamic
+    chain-walk (a while_loop inside the scan) the rules now audit."""
     from ..serving.engine import Engine
 
     infer = _small_lm_for_analysis(dtype=jnp.bfloat16)

@@ -148,6 +148,16 @@ KV_BLOCKS_TOTAL = _REG.gauge(
 KV_BLOCKS_USED = _REG.gauge(
     "ptpu_kv_blocks_used",
     "paged KV blocks referenced by live requests or the prefix cache")
+# effective-bytes companions (ISSUE 20): block counts x the engine's
+# quantization-aware bytes_per_block, so watch/SLO read real HBM — a
+# quantized pool reports its smaller footprint day one
+KV_BYTES_TOTAL = _REG.gauge(
+    "ptpu_kv_bytes_total",
+    "HBM bytes the paged KV pool reserves (quantization-aware)")
+KV_BYTES_USED = _REG.gauge(
+    "ptpu_kv_bytes_used",
+    "HBM bytes of paged KV blocks currently referenced "
+    "(quantization-aware)")
 PREFIX_HITS = _REG.counter(
     "ptpu_prefix_cache_hits_total",
     "admissions whose prompt matched a cached prefix chain (those "
@@ -849,6 +859,7 @@ def on_checkpoint(step, path, mode):
 def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
                     retired=0, engine="engine", dt=None, k=1,
                     dispatched=None, kv_used=None, kv_total=None,
+                    kv_bytes_used=None, kv_bytes_total=None,
                     prefix_hits=None, prefix_misses=None, preempted=0,
                     cache_hits=None, cache_misses=None,
                     cache_stale=None, cache_evictions=None,
@@ -889,6 +900,10 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
             KV_BLOCKS_TOTAL.set(kv_total)
         if kv_used is not None:
             KV_BLOCKS_USED.set(kv_used)
+        if kv_bytes_total is not None:
+            KV_BYTES_TOTAL.set(kv_bytes_total)
+        if kv_bytes_used is not None:
+            KV_BYTES_USED.set(kv_bytes_used)
         if preempted:
             SERVING_PREEMPTIONS.inc(preempted)
         if emitted:
@@ -918,6 +933,9 @@ def on_serving_step(active, slots, queue_depth, emitted=0, admitted=0,
             # window's hit rate is last-row arithmetic, not a sum
             extra["kv_used_blocks"] = kv_used
             extra["kv_total_blocks"] = kv_total
+            if kv_bytes_total is not None:
+                extra["kv_bytes_used"] = kv_bytes_used
+                extra["kv_bytes_total"] = kv_bytes_total
             extra["prefix_hits"] = prefix_hits
             extra["prefix_misses"] = prefix_misses
             if preempted:

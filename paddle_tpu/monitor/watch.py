@@ -459,11 +459,18 @@ def render_frame(state, path, slo_verdict=None, now=None,
         h = sum(r.get("prefix_hits") or 0 for r in rows)
         m = sum(r.get("prefix_misses") or 0 for r in rows)
         rate = "n/a" if h + m == 0 else "%.0f%%" % (100.0 * h / (h + m))
+        # real HBM, not block counts (ISSUE 20): engines stamp
+        # quantization-aware byte figures, so an int8 pool's line
+        # shows its actual (smaller) footprint
+        bu = sum(r.get("kv_bytes_used") or 0 for r in rows)
+        bt = sum(r.get("kv_bytes_total") or 0 for r in rows)
+        hbm = "" if not bt else "   hbm %.1f/%.1f MiB" % (
+            bu / 2**20, bt / 2**20)
         lines.append(
-            "kv        blocks %d/%d (%.0f%%)   prefix hits %d "
+            "kv        blocks %d/%d (%.0f%%)%s   prefix hits %d "
             "misses %d (hit rate %s)   preemptions %d"
             % (used, total, 100.0 * used / total if total else 0.0,
-               h, m, rate, state.total_preemptions))
+               hbm, h, m, rate, state.total_preemptions))
     spec_last = {}
     for s in state.serving_steps:
         if s.get("spec_dispatches") is not None:

@@ -165,6 +165,19 @@ PROBES = (
           50.0),
     Probe("autoscale_roll_shed", ("autoscale", "roll_shed"),
           "lower", 0.0, band_abs=0.0),
+    # block-kernel probes (ISSUE 20): the large-capacity step-time
+    # speedup of the chain-walk kernel over the dense gather, the
+    # capacity-scaling flatness ratio (how much faster gather grows
+    # with pool capacity than the block kernel — the acceptance
+    # figure), and the int8-KV arm's speedup. Missing on pre-20
+    # baselines -> skip, like every probe introduced mid-history
+    Probe("serving_block_kernel_speedup",
+          ("serving", "block_kernel_speedup"), "higher", 25.0,
+          ("serving", "block_kernel_spread_pct")),
+    Probe("serving_block_scale_ratio",
+          ("serving", "block_kernel_scale_ratio"), "higher", 25.0),
+    Probe("serving_block_quant_speedup",
+          ("serving", "block_kernel_quant_speedup"), "higher", 30.0),
 )
 
 

@@ -87,6 +87,7 @@ import jax
 import jax.numpy as jnp
 
 from ..monitor import runtime as _monrt
+from ..ops import paged_attention as _paged_ops
 from ..trace import runtime as _trc
 from . import kvpool as _kvpool
 from . import spec as _spec
@@ -267,7 +268,8 @@ class Engine:
                  admission_wait=None, name="engine", megastep=None,
                  paged=None, block_size=None, num_blocks=None,
                  prefix_cache=None, speculative=None, spec_gamma=None,
-                 spec_drafter=None, spec_layers=None):
+                 spec_drafter=None, spec_layers=None,
+                 block_kernel=None, kv_quant=None):
         if slots < 1:
             raise ValueError("slots must be >= 1, got %r" % (slots,))
         from .artifact import is_artifact_path, model_from_artifact
@@ -339,9 +341,52 @@ class Engine:
             self._prefix = (_kvpool.RadixCache(self._block_size,
                                                self._pool)
                             if use_prefix else None)
+            # block-native attention kernel (ISSUE 20): the default
+            # decode path walks only each slot's live block chain
+            # (ops/paged_attention online softmax); block_kernel=False
+            # (flag serving_block_kernel=0) is the PR-10 dense-gather
+            # escape hatch. attn_unroll: lax-fallback blocks per loop
+            # trip. kv_quant ('int8' / 'fp8', OFF by default): pool
+            # stores codes + per-vector scales — validated here so a
+            # bad flag fails at construction, not at first trace.
+            self._attn_unroll = max(1, int(_flag("serving_attn_unroll",
+                                                 1)))
+            kvq = (kv_quant if kv_quant is not None
+                   else _flag("serving_kv_quant", ""))
+            kvq = str(kvq or "").strip().lower()
+            self._kv_quant = kvq if kvq not in ("", "none", "off") \
+                else None
+            _paged_ops.kv_quant_spec(self._kv_quant)   # validate
+            # the kernel accumulates in fp32 — a DIFFERENT reduction
+            # order than the dense row math, so the bf16 serving
+            # cast's bitwise contract (engine == bf16 sequential
+            # baseline) only holds on the gather path: low-precision
+            # un-quantized pools keep gather by DEFAULT (explicit
+            # block_kernel=True still opts in; quantized pools are
+            # rtol-pinned, not bitwise, so they stay on the kernel)
+            kern_ok = (self._kv_quant is not None
+                       or jnp.dtype(model.word_emb.dtype)
+                       == jnp.dtype(jnp.float32))
+            self._block_kernel = bool(
+                block_kernel if block_kernel is not None
+                else (_flag("serving_block_kernel", True) and kern_ok))
+            dk = model.d_model // model.n_head
+            self._block_bytes = _kvpool.bytes_per_block(
+                model.n_layer, model.n_head, self._block_size, dk,
+                dtype_bytes=jnp.dtype(model.word_emb.dtype).itemsize,
+                kv_quant=self._kv_quant)
         else:
+            if kv_quant:
+                raise ValueError(
+                    "kv_quant requires the paged KV layout "
+                    "(per-block scales live beside the block pool); "
+                    "pass paged=True or drop kv_quant")
             self._pool = None
             self._prefix = None
+            self._block_kernel = False
+            self._attn_unroll = 1
+            self._kv_quant = None
+            self._block_bytes = 0
         # speculative decode (ISSUE 13): γ drafted tokens per live slot
         # verified in ONE scoring dispatch. γ is a STATIC shape
         # constant of the scoring program ([S, γ+1] feed), so one γ =
@@ -573,7 +618,8 @@ class Engine:
     def _init_state(self):
         if self._paged:
             s = self.model._init_paged_state(self._pool.num_blocks,
-                                             self._block_size)
+                                             self._block_size,
+                                             kv_quant=self._kv_quant)
         else:
             s = self.model._init_state(self.slots)
         z = lambda dt: jnp.zeros((self.slots,), dt)
@@ -606,7 +652,9 @@ class Engine:
         tok, pos, active = state["tok"], state["pos"], state["active"]
         if self._paged:
             logits, state = self.model._step_logits_paged(
-                tok, state, pos, btab, write_mask=active)
+                tok, state, pos, btab, write_mask=active,
+                block_kernel=self._block_kernel,
+                attn_unroll=self._attn_unroll)
         else:
             logits, state = self.model._step_logits_slots(
                 tok, state, pos, write_mask=active)
@@ -688,7 +736,9 @@ class Engine:
         toks = jnp.concatenate([tok[:, None], drafts], axis=1)
         nd = jnp.where(active, dn[:, 0], 0)
         logits, state = self.model._spec_logits_paged(
-            toks, state, pos, btab, nd, write_mask=active)
+            toks, state, pos, btab, nd, write_mask=active,
+            block_kernel=self._block_kernel,
+            attn_unroll=self._attn_unroll)
         logits32 = logits.astype(jnp.float32)        # [S, C, V]
         logp = jax.nn.log_softmax(logits32)
         greedy = jnp.argmax(logp, axis=-1).astype(jnp.int32)
@@ -750,14 +800,16 @@ class Engine:
         acceptance rate — never the output."""
         state = dict(state)
         active = state["active"]
-        pool = {"pool_k": state["pool_k"], "pool_v": state["pool_v"]}
+        pool = self.model._pool_slice(state)
 
         def body(carry, _):
             pool, tok, pos, j = carry
             wmask = active & (j <= n_draft)
             logits, pool = self.model._step_logits_paged(
                 tok, pool, pos, btab, write_mask=wmask,
-                n_layers=self._spec_layers)
+                n_layers=self._spec_layers,
+                block_kernel=self._block_kernel,
+                attn_unroll=self._attn_unroll)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (pool, nxt, pos + 1, j + 1), nxt
 
@@ -766,15 +818,16 @@ class Engine:
             (pool, state["tok"], state["pos"],
              jnp.zeros((), jnp.int32)),
             None, length=self._spec_gamma)
-        state["pool_k"], state["pool_v"] = pool["pool_k"], \
-            pool["pool_v"]
+        state.update(pool)
         return state, jnp.transpose(drafts)          # [γ,S] → [S,γ]
 
     def _prefill_impl(self, state, slot, toks, start, n_valid,
                       btab_row):
         if self._paged:
             return self.model._prefill_chunk_paged(
-                dict(state), toks, start, n_valid, btab_row)
+                dict(state), toks, start, n_valid, btab_row,
+                block_kernel=self._block_kernel,
+                attn_unroll=self._attn_unroll)
         return self.model._prefill_chunk_slot(
             dict(state), slot, toks, start, n_valid)
 
@@ -807,7 +860,9 @@ class Engine:
         layer) so a request whose FULLY block-aligned prompt matched
         the cache can write its first decode position privately."""
         state = dict(state)
-        for name in ("pool_k", "pool_v"):
+        for name in ("pool_k", "pool_v", "pool_ks", "pool_vs"):
+            if name not in state:
+                continue
             a = state[name]
             state[name] = a.at[dst].set(a[src])
         return state
@@ -1069,6 +1124,9 @@ class Engine:
                         self.stats["kv_peak_blocks"], used)
                     kv = {"kv_used": used,
                           "kv_total": self._pool.num_blocks,
+                          "kv_bytes_used": used * self._block_bytes,
+                          "kv_bytes_total": (self._pool.num_blocks
+                                             * self._block_bytes),
                           "prefix_hits": self.stats["prefix_hits"],
                           "prefix_misses": self.stats["prefix_misses"],
                           "preempted": self._preempted_iter}

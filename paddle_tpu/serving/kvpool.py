@@ -48,13 +48,26 @@ __all__ = ["BlockPool", "RadixCache", "bytes_per_block"]
 
 
 def bytes_per_block(n_layer, n_head, block_size, head_dim,
-                    dtype_bytes=4):
+                    dtype_bytes=4, kv_quant=None, scale_bytes=4):
     """HBM bytes ONE pool block holds: K and V for ``block_size``
     cache positions across every layer and head. The autoparallel
     planner's capacity term prices per-plan paged-KV pools with this
-    (``transform/autoparallel.plan_hbm_bytes``)."""
+    (``transform/autoparallel.plan_hbm_bytes``).
+
+    ``kv_quant`` prices a quantized pool (``"int8"``/``"fp8"``): one
+    code byte per element plus one ``scale_bytes`` scale per
+    (position, head) vector — the layout
+    ``models/transformer_infer._init_paged_state`` allocates. A
+    head_dim-64 fp32 pool drops to ~26% of its dense bytes."""
+    kvq = str(kv_quant or "").strip().lower()
+    if kvq in ("", "none", "off"):
+        per_vec = int(head_dim) * int(dtype_bytes)
+    else:
+        # ops/paged_attention.kv_quant_spec validates the kind; both
+        # supported kinds store 1-byte codes + a per-vector scale.
+        per_vec = int(head_dim) * 1 + int(scale_bytes)
     return (2 * int(n_layer) * int(n_head) * int(block_size)
-            * int(head_dim) * int(dtype_bytes))
+            * per_vec)
 
 
 class BlockPool:

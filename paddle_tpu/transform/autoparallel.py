@@ -284,14 +284,18 @@ def plan_cost(spec, axes, microbatches=1,
 
 
 def plan_hbm_bytes(spec, axes, block_size=KV_BLOCK_SIZE,
-                   optimizer_mult=OPTIMIZER_STATE_MULT):
+                   optimizer_mult=OPTIMIZER_STATE_MULT,
+                   kv_quant=None):
     """Modeled PER-CHIP HBM bytes of one assignment — the capacity
     term PR 9 left open (ISSUE 10): the dense parameter shard dp
     replicates (tp/pp/ep shard it) times (1 + optimizer_mult) for
     grads + Adam moments, plus the paged-KV pool a decode tier of the
     same shape reserves, priced with ``serving.kvpool.bytes_per_block``
     (each per-chip batch row keeps ceil(seq_shard / block_size) blocks
-    of its layer/head shard). Returns (total, breakdown)."""
+    of its layer/head shard). ``kv_quant`` (or ``spec.kv_quant`` when
+    the caller leaves it None) prices an int8/fp8-quantized pool —
+    the capacity filter then admits plans the dense pool would
+    reject. Returns (total, breakdown)."""
     from ..serving.kvpool import bytes_per_block
     dp, tp, pp, sp, ep = (axes["dp"], axes["tp"], axes["pp"],
                           axes["sp"], axes["ep"])
@@ -301,9 +305,12 @@ def plan_hbm_bytes(spec, axes, block_size=KV_BLOCK_SIZE,
     rows = max(1, spec.batch // dp)
     seq_shard = -(-spec.seq // sp)
     blocks = rows * (-(-seq_shard // int(block_size)))
+    if kv_quant is None:
+        kv_quant = getattr(spec, "kv_quant", None)
     kv = blocks * bytes_per_block(
         max(1, spec.n_layer // pp), max(1, spec.n_head // tp),
-        block_size, dk, dtype_bytes=spec.dtype_bytes)
+        block_size, dk, dtype_bytes=spec.dtype_bytes,
+        kv_quant=kv_quant)
     return params + kv, {"hbm_param_bytes": params, "hbm_kv_bytes": kv}
 
 
